@@ -1,0 +1,34 @@
+"""raft_tpu — a TPU-native rebuild of RAPIDS RAFT's capability surface.
+
+JAX/XLA/Pallas implementation of the primitives + infrastructure layer that
+vector-search and ML libraries build on: handle/resources, dense & sparse
+linear algebra, matrix ops (select_k top-k), random generation, stats/metrics,
+solvers, an injectable collective-communication layer over device meshes, and
+the ANN index family (brute-force / IVF-Flat / IVF-PQ / CAGRA) plus kmeans.
+
+Design (see SURVEY.md §7): not a port — view-first functional APIs over
+``jax.Array``, SPMD via ``shard_map`` over named meshes, Pallas kernels for the
+hot ops, counter-based RNG, and an optional injectable ``Resources`` handle
+mirroring ``raft::resources`` (``cpp/include/raft/core/resources.hpp:47``).
+"""
+
+__version__ = "0.1.0"
+
+from . import core
+from .core import Resources, DeviceResources, default_resources
+
+_SUBMODULES = (
+    "linalg", "matrix", "random", "stats", "distance", "neighbors",
+    "cluster", "comms", "sparse", "solver", "spectral", "label", "utils",
+)
+
+
+def __getattr__(name):
+    # Lazy submodule import keeps `import raft_tpu` light.
+    if name in _SUBMODULES:
+        import importlib
+
+        mod = importlib.import_module(f"raft_tpu.{name}")
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'raft_tpu' has no attribute {name!r}")

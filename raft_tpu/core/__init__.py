@@ -1,0 +1,42 @@
+"""raft_tpu.core — runtime layer (handle/resources, mesh, errors, tracing, IO).
+
+TPU-native analog of ``cpp/include/raft/core`` (see SURVEY.md §2.1).
+"""
+
+from .errors import RaftError, LogicError, expects, fail
+from .resources import (
+    Resources,
+    DeviceResources,
+    default_resources,
+    set_default_resources,
+    get_mesh,
+    get_devices,
+    get_rng_key,
+    get_comms,
+    set_comms,
+    get_workspace_limit,
+)
+from .mesh import make_mesh, make_1d_mesh, local_mesh, distributed_init, DATA_AXIS, SHARD_AXIS
+from .array import wrap_array, check_rank, check_same_shape, check_dtype, to_numpy
+from .bitset import Bitset, Bitmap, popc
+from .serialize import (
+    serialize_mdspan,
+    deserialize_mdspan,
+    serialize_scalar,
+    deserialize_scalar,
+    save_arrays,
+    load_arrays,
+)
+from . import interruptible, tracing, logging
+
+__all__ = [
+    "RaftError", "LogicError", "expects", "fail",
+    "Resources", "DeviceResources", "default_resources", "set_default_resources",
+    "get_mesh", "get_devices", "get_rng_key", "get_comms", "set_comms", "get_workspace_limit",
+    "make_mesh", "make_1d_mesh", "local_mesh", "distributed_init", "DATA_AXIS", "SHARD_AXIS",
+    "wrap_array", "check_rank", "check_same_shape", "check_dtype", "to_numpy",
+    "Bitset", "Bitmap", "popc",
+    "serialize_mdspan", "deserialize_mdspan", "serialize_scalar", "deserialize_scalar",
+    "save_arrays", "load_arrays",
+    "interruptible", "tracing", "logging",
+]

@@ -1,0 +1,63 @@
+"""Array ingestion/validation — the role of pylibraft's ``cai_wrapper`` /
+``ai_wrapper`` (``python/pylibraft/pylibraft/common/cai_wrapper.py:10,32``) and
+the mdspan conversion layer (``common/mdspan.pyx:40``).
+
+Anything array-like (numpy, jax.Array, torch CPU tensor, lists, objects with
+``__array__``/``__dlpack__``) normalizes to a ``jax.Array`` with validated
+rank/dtype.  Output conversion (``auto_convert_output`` parity,
+``common/outputs.py``) returns numpy on request.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .errors import expects
+
+__all__ = ["wrap_array", "check_rank", "check_same_shape", "check_dtype", "to_numpy"]
+
+ArrayLike = Union[jax.Array, np.ndarray, Sequence]
+
+
+def wrap_array(
+    x: ArrayLike,
+    dtype=None,
+    ndim: Optional[int] = None,
+    name: str = "array",
+) -> jax.Array:
+    """Normalize any array-like to ``jax.Array`` (``wrap_array`` parity)."""
+    if hasattr(x, "__dlpack__") and not isinstance(x, (jax.Array, np.ndarray)):
+        try:  # torch / cupy style producers
+            x = jnp.from_dlpack(x)
+        except Exception:
+            x = np.asarray(x)
+    arr = jnp.asarray(x, dtype=dtype)
+    if ndim is not None:
+        check_rank(arr, ndim, name)
+    return arr
+
+
+def check_rank(x, ndim: int, name: str = "array") -> None:
+    expects(x.ndim == ndim, f"{name}: expected rank {ndim}, got {x.ndim}")
+
+
+def check_same_shape(a, b, name: str = "arrays") -> None:
+    expects(tuple(a.shape) == tuple(b.shape), f"{name}: shape mismatch {a.shape} vs {b.shape}")
+
+
+def check_dtype(x, dtypes, name: str = "array") -> None:
+    if not isinstance(dtypes, (tuple, list)):
+        dtypes = (dtypes,)
+    expects(
+        any(x.dtype == np.dtype(d) for d in dtypes),
+        f"{name}: dtype {x.dtype} not in {[np.dtype(d).name for d in dtypes]}",
+    )
+
+
+def to_numpy(x) -> np.ndarray:
+    """Host copy (``auto_convert_output`` role)."""
+    return np.asarray(x)

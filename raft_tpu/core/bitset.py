@@ -1,0 +1,152 @@
+"""Bitset / bitmap — parity with ``cpp/include/raft/core/bitset.hpp:33,279`` and
+``core/bitmap.hpp:34``.
+
+RAFT's device bitset packs bits into 32-bit words and offers test / set / flip /
+count plus conversion helpers (``util/popc.cuh`` for counting).  The TPU version
+is a functional pytree: ops return new bitsets (XLA turns the copies into
+in-place updates under donation).  A bitmap is the 2-D (rows × cols) view used
+for sample filtering in ANN search.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .errors import expects
+
+__all__ = ["Bitset", "Bitmap", "popc"]
+
+_WORD_BITS = 32
+
+
+def _n_words(n_bits: int) -> int:
+    return (n_bits + _WORD_BITS - 1) // _WORD_BITS
+
+
+def popc(words: jax.Array) -> jax.Array:
+    """Population count over a word array (``util/popc.cuh`` parity)."""
+    return jnp.sum(jax.lax.population_count(words.astype(jnp.uint32)), dtype=jnp.int64
+                   if jax.config.jax_enable_x64 else jnp.int32)
+
+
+@jax.tree_util.register_pytree_node_class
+class Bitset:
+    """Packed device bitset (``raft::core::bitset``, ``core/bitset.hpp:279``)."""
+
+    def __init__(self, words: jax.Array, n_bits: int):
+        self.words = words
+        self.n_bits = n_bits
+
+    def _with_words(self, words: jax.Array) -> "Bitset":
+        """Rebuild preserving the concrete type (Bitmap keeps rows/cols)."""
+        leaves, treedef = jax.tree_util.tree_flatten(self)
+        del leaves
+        return jax.tree_util.tree_unflatten(treedef, (words,))
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def create(cls, n_bits: int, default_value: bool = True) -> "Bitset":
+        fill = jnp.uint32(0xFFFFFFFF) if default_value else jnp.uint32(0)
+        words = jnp.full((_n_words(n_bits),), fill, dtype=jnp.uint32)
+        return cls(words, n_bits)._mask_tail()
+
+    @classmethod
+    def from_bool_array(cls, mask) -> "Bitset":
+        mask = jnp.asarray(mask, dtype=bool).reshape(-1)
+        n = mask.shape[0]
+        pad = _n_words(n) * _WORD_BITS - n
+        bits = jnp.concatenate([mask, jnp.zeros((pad,), bool)]).reshape(-1, _WORD_BITS)
+        weights = (jnp.uint32(1) << jnp.arange(_WORD_BITS, dtype=jnp.uint32))
+        words = jnp.sum(jnp.where(bits, weights[None, :], jnp.uint32(0)), axis=1, dtype=jnp.uint32)
+        return cls(words, n)
+
+    def _mask_tail(self) -> "Bitset":
+        tail = self.n_bits % _WORD_BITS
+        if tail == 0:
+            return self
+        mask = jnp.uint32((1 << tail) - 1)
+        return self._with_words(self.words.at[-1].set(self.words[-1] & mask))
+
+    # -- queries -----------------------------------------------------------
+    def test(self, idx) -> jax.Array:
+        """Test bit(s) at ``idx`` (scalar or array) → bool array."""
+        idx = jnp.asarray(idx)
+        word = self.words[idx // _WORD_BITS]
+        return ((word >> (idx % _WORD_BITS).astype(jnp.uint32)) & 1).astype(bool)
+
+    def count(self) -> jax.Array:
+        """Number of set bits (``bitset::count``; uses popc)."""
+        return popc(self.words)
+
+    def to_bool_array(self) -> jax.Array:
+        shifts = jnp.arange(_WORD_BITS, dtype=jnp.uint32)
+        bits = ((self.words[:, None] >> shifts[None, :]) & 1).astype(bool)
+        return bits.reshape(-1)[: self.n_bits]
+
+    # -- mutation (functional) --------------------------------------------
+    def set(self, idx, value: bool = True) -> "Bitset":
+        # Build a per-word OR mask first: several indices can land in the same
+        # word, so a plain scatter-set would drop all but one (the CUDA version
+        # uses atomicOr; the XLA version uses add-scatter over deduplicated bits).
+        idx = jnp.asarray(idx).reshape(-1)
+        order = jnp.argsort(idx)
+        sidx = idx[order]
+        first = jnp.concatenate([jnp.ones((1,), bool), sidx[1:] != sidx[:-1]])
+        bit = jnp.where(first, jnp.uint32(1) << (sidx % _WORD_BITS).astype(jnp.uint32), jnp.uint32(0))
+        mask = jnp.zeros_like(self.words).at[sidx // _WORD_BITS].add(bit)
+        return self._with_words((self.words | mask) if value else (self.words & ~mask))
+
+    def flip(self) -> "Bitset":
+        return self._with_words(~self.words)._mask_tail()
+
+    def reset(self, default_value: bool = True) -> "Bitset":
+        fill = jnp.uint32(0xFFFFFFFF) if default_value else jnp.uint32(0)
+        return self._with_words(jnp.full_like(self.words, fill))._mask_tail()
+
+    def __and__(self, other: "Bitset") -> "Bitset":
+        expects(self.n_bits == other.n_bits, "bitset size mismatch")
+        return self._with_words(self.words & other.words)
+
+    def __or__(self, other: "Bitset") -> "Bitset":
+        expects(self.n_bits == other.n_bits, "bitset size mismatch")
+        return self._with_words(self.words | other.words)
+
+    # -- pytree ------------------------------------------------------------
+    def tree_flatten(self):
+        return (self.words,), self.n_bits
+
+    @classmethod
+    def tree_unflatten(cls, n_bits, children):
+        return cls(children[0], n_bits)
+
+
+@jax.tree_util.register_pytree_node_class
+class Bitmap(Bitset):
+    """2-D bit view: ``rows × cols`` (``core/bitmap.hpp:34``)."""
+
+    def __init__(self, words: jax.Array, rows: int, cols: int):
+        super().__init__(words, rows * cols)
+        self.rows = rows
+        self.cols = cols
+
+    @classmethod
+    def create_2d(cls, rows: int, cols: int, default_value: bool = True) -> "Bitmap":
+        base = Bitset.create(rows * cols, default_value)
+        return cls(base.words, rows, cols)
+
+    def test2(self, row, col) -> jax.Array:
+        return self.test(jnp.asarray(row) * self.cols + jnp.asarray(col))
+
+    def set2(self, row, col, value: bool = True) -> "Bitmap":
+        return self.set(jnp.asarray(row) * self.cols + jnp.asarray(col), value)
+
+    def tree_flatten(self):
+        return (self.words,), (self.rows, self.cols)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        rows, cols = aux
+        return cls(children[0], rows, cols)

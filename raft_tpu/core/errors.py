@@ -1,0 +1,30 @@
+"""Error hierarchy + check macros — parity with ``cpp/include/raft/core/error.hpp``.
+
+RAFT exposes ``raft::exception`` / ``raft::logic_error`` plus the ``RAFT_EXPECTS``
+and ``RAFT_FAIL`` macros; we keep the same verbs as plain functions.  The CUDA /
+cublas / cusolver status macros have no TPU analog — XLA raises Python
+exceptions directly.
+"""
+
+from __future__ import annotations
+
+__all__ = ["RaftError", "LogicError", "expects", "fail"]
+
+
+class RaftError(RuntimeError):
+    """Base exception (``raft::exception``, ``core/error.hpp``)."""
+
+
+class LogicError(RaftError):
+    """Invalid API usage (``raft::logic_error``)."""
+
+
+def expects(condition: bool, message: str = "condition violated") -> None:
+    """``RAFT_EXPECTS`` parity: raise :class:`LogicError` unless ``condition``."""
+    if not condition:
+        raise LogicError(message)
+
+
+def fail(message: str) -> None:
+    """``RAFT_FAIL`` parity: unconditional raise."""
+    raise LogicError(message)

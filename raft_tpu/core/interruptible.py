@@ -1,0 +1,79 @@
+"""Cooperative cancellation — parity with ``cpp/include/raft/core/interruptible.hpp:64``.
+
+RAFT lets long-running host loops be cancelled at stream-sync points
+(``interruptible::synchronize`` / ``yield`` / ``cancel``).  The TPU analog:
+driver loops (kmeans iterations, index build batches, Lanczos restarts) call
+:func:`yield_now` between device dispatches; another thread (or a SIGINT
+handler installed via :func:`install_sigint_handler`) flags cancellation, and
+the loop raises :class:`InterruptedException` at the next check.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+
+__all__ = [
+    "InterruptedException",
+    "cancel",
+    "clear",
+    "yield_now",
+    "synchronize",
+    "install_sigint_handler",
+]
+
+
+class InterruptedException(RuntimeError):
+    """Raised at a yield point after :func:`cancel` (``raft::interrupted_exception``)."""
+
+
+_state = threading.local()
+_global_cancel = threading.Event()
+
+
+def cancel(thread: threading.Thread = None) -> None:
+    """Request cancellation (``interruptible::cancel``). Global: flags every
+    yield point in the process (per-thread token granularity is not needed on
+    a single dispatch thread)."""
+    _global_cancel.set()
+
+
+def clear() -> None:
+    _global_cancel.clear()
+
+
+def yield_now() -> None:
+    """Throw if cancelled (``interruptible::yield``)."""
+    if _global_cancel.is_set():
+        _global_cancel.clear()
+        raise InterruptedException("raft_tpu computation cancelled")
+
+
+def synchronize(x=None):
+    """Cancellable device sync (``interruptible::synchronize``): check, block
+    on ``x`` (or a trivial transfer), check again."""
+    import jax
+
+    yield_now()
+    if x is None:
+        x = jax.device_put(0)
+    out = jax.block_until_ready(x)
+    yield_now()
+    return out
+
+
+def install_sigint_handler() -> None:
+    """Route SIGINT to :func:`cancel` (parity with pylibraft's
+    ``common/interruptible.pyx`` SIGINT→cancel bridge)."""
+    prev = signal.getsignal(signal.SIGINT)
+    # Chain only to user-installed handlers: chaining to the default handler
+    # would re-raise KeyboardInterrupt immediately, defeating the whole point
+    # of deferring cancellation to the next yield point.
+    chain = callable(prev) and prev is not signal.default_int_handler
+
+    def handler(signum, frame):
+        cancel()
+        if chain:
+            prev(signum, frame)
+
+    signal.signal(signal.SIGINT, handler)

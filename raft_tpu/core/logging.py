@@ -1,0 +1,47 @@
+"""Logging — parity with ``cpp/include/raft/core/logger.hpp``.
+
+The reference wraps rapids_logger with a default logger, compile-time level,
+and an env-var-controlled file sink (``RAFT_DEBUG_LOG_FILE``,
+``core/logger.hpp:27``).  Here we wrap :mod:`logging` the same way: one default
+logger named ``raft_tpu``, level from ``RAFT_TPU_LOG_LEVEL``, optional file
+sink from ``RAFT_TPU_DEBUG_LOG_FILE``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Sequence
+
+__all__ = ["default_logger", "log_trace_vec"]
+
+_LOGGER_NAME = "raft_tpu"
+_configured = False
+
+
+def default_logger() -> logging.Logger:
+    """The process-wide logger (``raft::default_logger()``, ``core/logger.hpp:46``)."""
+    global _configured
+    logger = logging.getLogger(_LOGGER_NAME)
+    if not _configured:
+        level = os.environ.get("RAFT_TPU_LOG_LEVEL", "INFO").upper()
+        logger.setLevel(getattr(logging, level, logging.INFO))
+        if not logger.handlers:
+            handler = logging.StreamHandler()
+            handler.setFormatter(logging.Formatter("[%(levelname)s] [%(name)s] %(message)s"))
+            logger.addHandler(handler)
+        log_file = os.environ.get("RAFT_TPU_DEBUG_LOG_FILE")
+        if log_file:
+            fh = logging.FileHandler(log_file)
+            fh.setLevel(logging.DEBUG)
+            logger.addHandler(fh)
+            logger.setLevel(logging.DEBUG)
+        _configured = True
+    return logger
+
+
+def log_trace_vec(name: str, values: Sequence, limit: int = 16) -> None:
+    """``RAFT_LOG_TRACE_VEC`` parity (``core/logger.hpp:58``): trace-log a
+    bounded prefix of a vector."""
+    vals = list(values[:limit])
+    default_logger().debug("%s: %s%s", name, vals, "..." if len(values) > limit else "")

@@ -1,0 +1,86 @@
+"""Device-mesh construction helpers — the TPU replacement for RAFT's
+stream/device plumbing and the SNMG/MNMG handle variants.
+
+Reference parity: ``core/device_resources_snmg.hpp:36`` (single-node multi-GPU
+clique) maps to a single-process mesh over the local devices;
+``raft_dask.common.Comms`` bootstrap (``common/comms.py:161``) maps to
+``jax.distributed.initialize`` + a global mesh.  Axis-name conventions used
+throughout the framework:
+
+* ``"data"`` — batch/query-parallel axis (DP; rides DCN when multi-host),
+* ``"shard"`` — database/index-shard axis (the MNMG index-shard model of
+  §2.9/§5.7 of the survey; rides ICI).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from .errors import expects
+
+__all__ = [
+    "make_mesh",
+    "make_1d_mesh",
+    "local_mesh",
+    "distributed_init",
+    "DATA_AXIS",
+    "SHARD_AXIS",
+]
+
+DATA_AXIS = "data"
+SHARD_AXIS = "shard"
+
+
+def make_mesh(
+    shape: Sequence[int],
+    axis_names: Sequence[str],
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> jax.sharding.Mesh:
+    """Build a named mesh of the given logical shape over ``devices``.
+
+    Uses ``jax.experimental.mesh_utils`` device ordering when available so the
+    innermost axis maps to ICI neighbors (collectives ride ICI, not DCN).
+    """
+    if devices is None:
+        try:
+            from jax.experimental import mesh_utils
+
+            dev_array = mesh_utils.create_device_mesh(tuple(shape))
+            return jax.sharding.Mesh(dev_array, tuple(axis_names))
+        except Exception:
+            devices = jax.devices()
+    dev = np.asarray(devices)
+    expects(dev.size == int(np.prod(shape)), f"need {int(np.prod(shape))} devices, have {dev.size}")
+    return jax.sharding.Mesh(dev.reshape(tuple(shape)), tuple(axis_names))
+
+
+def make_1d_mesh(axis_name: str = SHARD_AXIS, devices=None) -> jax.sharding.Mesh:
+    devices = jax.devices() if devices is None else list(devices)
+    return jax.sharding.Mesh(np.asarray(devices), (axis_name,))
+
+
+def local_mesh(axis_name: str = SHARD_AXIS) -> jax.sharding.Mesh:
+    """SNMG parity (``device_resources_snmg.hpp``): mesh over local devices."""
+    return jax.sharding.Mesh(np.asarray(jax.local_devices()), (axis_name,))
+
+
+def distributed_init(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host bootstrap — replaces the entire NCCL-uniqueId/Dask-RPC dance
+    of ``raft_dask.common.Comms.init()`` (``common/comms.py:161``) with JAX's
+    built-in coordinator.  No-op when already initialized or single-process.
+    """
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except (RuntimeError, ValueError):
+        pass  # already initialized or single-process defaults unavailable

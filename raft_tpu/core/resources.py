@@ -1,0 +1,266 @@
+"""Lazy, type-indexed resource container — the TPU-native analog of RAFT's handle.
+
+Reference parity: ``cpp/include/raft/core/resources.hpp:47`` (``class resources``:
+a vector of lazily-constructed resource cells keyed by a resource-type enum) and
+``cpp/include/raft/core/device_resources.hpp:51`` (convenience facade).
+
+On TPU there are no cuBLAS/cuSOLVER/stream handles to manage; the resources a
+primitive needs are instead:
+
+* the **device set / mesh** the computation is sharded over,
+* a **PRNG key stream** (JAX's counter-based keys match RAFT's stateless
+  Philox/PCG design, ``random/rng_state.hpp:19``),
+* an injected **communicator** (``resource::set_comms`` parity,
+  ``core/resource/comms.hpp``),
+* memory / donation policy knobs and a workspace byte limit,
+* a logger.
+
+Like the reference, accessors lazily install a default factory on first use
+(``resources::ensure_default_factory``, ``core/resources.hpp:100``), copies of
+the container share resource cells, and user code can override any slot before
+first use.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import numpy as np
+
+from .errors import RaftError, expects
+
+__all__ = [
+    "Resources",
+    "DeviceResources",
+    "default_resources",
+    "set_default_resources",
+    # accessor namespace (resource.get_* parity)
+    "get_mesh",
+    "get_devices",
+    "get_rng_key",
+    "get_comms",
+    "set_comms",
+    "get_workspace_limit",
+]
+
+
+class Resources:
+    """Type-indexed lazy resource registry (``core/resources.hpp:47``).
+
+    Slots are keyed by string (the Python analog of the 22-entry
+    ``resource_type`` enum in ``core/resource/resource_types.hpp:24``).  A slot
+    holds either a realized resource or a factory; factories run at most once,
+    on first access, under a lock — mirroring the thread-safety contract of
+    ``core/resources.hpp:27-35``.
+    """
+
+    # Well-known slot names (enum parity).
+    DEVICES = "devices"
+    MESH = "mesh"
+    RNG_SEED = "rng_seed"
+    RNG_COUNTER = "rng_counter"
+    COMMS = "comms"
+    SUB_COMMS = "sub_comms"
+    WORKSPACE_LIMIT = "workspace_limit"
+    LOGGER = "logger"
+    DEFAULT_DTYPE = "default_dtype"
+    DONATE = "donate"
+
+    def __init__(self, **overrides: Any) -> None:
+        self._lock = threading.RLock()
+        self._cells: Dict[str, Any] = {}
+        self._factories: Dict[str, Callable[["Resources"], Any]] = {}
+        self._install_default_factories()
+        for name, value in overrides.items():
+            self.set_resource(name, value)
+
+    # -- factory / cell protocol (resource_types.hpp:58-97 parity) ---------
+    def add_resource_factory(self, name: str, factory: Callable[["Resources"], Any]) -> None:
+        """Register/replace the factory for ``name`` (``resources.hpp:81``)."""
+        with self._lock:
+            self._factories[name] = factory
+            self._cells.pop(name, None)
+
+    def set_resource(self, name: str, value: Any) -> None:
+        """Directly install a realized resource into a slot."""
+        with self._lock:
+            self._cells[name] = value
+
+    def has_resource_factory(self, name: str) -> bool:
+        with self._lock:
+            return name in self._factories or name in self._cells
+
+    def get_resource(self, name: str) -> Any:
+        """Fetch a resource, lazily running its factory (``resources.hpp:120``)."""
+        with self._lock:
+            if name not in self._cells:
+                factory = self._factories.get(name)
+                if factory is None:
+                    raise RaftError(f"no resource or factory registered for {name!r}")
+                self._cells[name] = factory(self)
+            return self._cells[name]
+
+    def copy(self) -> "Resources":
+        """A copy *shares* realized resource cells (``resources.hpp`` copy ctor)."""
+        other = Resources.__new__(Resources)
+        other._lock = threading.RLock()
+        with self._lock:
+            other._cells = dict(self._cells)
+            other._factories = dict(self._factories)
+        return other
+
+    # -- defaults ----------------------------------------------------------
+    def _install_default_factories(self) -> None:
+        self.add_resource_factory(self.DEVICES, lambda _res: tuple(jax.devices()))
+        self.add_resource_factory(self.MESH, _default_mesh_factory)
+        self.add_resource_factory(self.RNG_SEED, lambda _res: 0)
+        self.add_resource_factory(self.RNG_COUNTER, lambda _res: _Counter())
+        self.add_resource_factory(self.WORKSPACE_LIMIT, lambda _res: None)
+        self.add_resource_factory(self.DEFAULT_DTYPE, lambda _res: np.float32)
+        self.add_resource_factory(self.DONATE, lambda _res: False)
+        self.add_resource_factory(self.LOGGER, _default_logger_factory)
+
+    # -- convenience properties -------------------------------------------
+    @property
+    def devices(self) -> Sequence[jax.Device]:
+        return self.get_resource(self.DEVICES)
+
+    @property
+    def mesh(self) -> jax.sharding.Mesh:
+        return self.get_resource(self.MESH)
+
+    @property
+    def logger(self):
+        return self.get_resource(self.LOGGER)
+
+    def rng_key(self, advance: bool = True) -> jax.Array:
+        """A fresh PRNG key from the handle's key stream.
+
+        RAFT parity: ``RngState`` seed+subsequence (``random/rng_state.hpp:19``)
+        — counter-based, so successive calls yield independent streams without
+        mutable device state.
+        """
+        seed = self.get_resource(self.RNG_SEED)
+        counter: _Counter = self.get_resource(self.RNG_COUNTER)
+        sub = counter.next() if advance else counter.peek()
+        return jax.random.fold_in(jax.random.PRNGKey(seed), sub)
+
+    def sync(self, *arrays) -> None:
+        """Wait for device work (``device_resources::sync_stream`` parity).
+
+        Pass the arrays you need completed — PJRT orders completion per
+        buffer, not per device, so only ``block_until_ready`` on a value
+        gives a hard guarantee.  With no arguments this drains pending JAX
+        effects (``jax.effects_barrier``), a best-effort global barrier.
+        """
+        if arrays:
+            jax.block_until_ready(arrays)
+        else:
+            jax.effects_barrier()
+
+
+class _Counter:
+    def __init__(self) -> None:
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def next(self) -> int:
+        with self._lock:
+            self._v += 1
+            return self._v
+
+    def peek(self) -> int:
+        with self._lock:
+            return self._v
+
+
+def _default_mesh_factory(res: Resources) -> jax.sharding.Mesh:
+    devices = np.asarray(res.get_resource(Resources.DEVICES))
+    return jax.sharding.Mesh(devices.reshape(-1), ("data",))
+
+
+def _default_logger_factory(_res: Resources):
+    from . import logging as raft_logging
+
+    return raft_logging.default_logger()
+
+
+class DeviceResources(Resources):
+    """Convenience facade preconfigured for the local device set.
+
+    Parity: ``raft::device_resources`` (``core/device_resources.hpp:51``).
+    Accepts an explicit mesh (the TPU analog of choosing device id + streams).
+    """
+
+    def __init__(
+        self,
+        mesh: Optional[jax.sharding.Mesh] = None,
+        seed: Optional[int] = None,
+        **overrides: Any,
+    ) -> None:
+        super().__init__(**overrides)
+        if mesh is not None:
+            self.set_resource(Resources.MESH, mesh)
+            self.set_resource(Resources.DEVICES, tuple(mesh.devices.flat))
+        if seed is not None:
+            self.set_resource(Resources.RNG_SEED, seed)
+
+
+_default: Optional[Resources] = None
+_default_lock = threading.Lock()
+
+
+def default_resources() -> Resources:
+    """Process-wide default handle (``device_resources_manager`` parity,
+    ``core/device_resources_manager.hpp:75``)."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = DeviceResources()
+        return _default
+
+
+def set_default_resources(res: Resources) -> None:
+    global _default
+    with _default_lock:
+        _default = res
+
+
+def _resolve(res: Optional[Resources]) -> Resources:
+    return res if res is not None else default_resources()
+
+
+# -- accessor functions (raft::resource::get_* parity) ---------------------
+
+def get_mesh(res: Optional[Resources] = None) -> jax.sharding.Mesh:
+    return _resolve(res).mesh
+
+
+def get_devices(res: Optional[Resources] = None) -> Sequence[jax.Device]:
+    return _resolve(res).devices
+
+
+def get_rng_key(res: Optional[Resources] = None) -> jax.Array:
+    return _resolve(res).rng_key()
+
+
+def get_comms(res: Optional[Resources] = None):
+    """Fetch the injected communicator (``resource::get_comms`` parity).
+
+    Raises if none was injected, like the reference's
+    ``RAFT_EXPECTS(has_resource_factory(...), "comms not initialized")``.
+    """
+    r = _resolve(res)
+    expects(r.has_resource_factory(Resources.COMMS), "communicator not initialized on this handle")
+    return r.get_resource(Resources.COMMS)
+
+
+def set_comms(res: Resources, comms) -> None:
+    """Inject a communicator (``resource::set_comms``, ``core/resource/comms.hpp``)."""
+    res.set_resource(Resources.COMMS, comms)
+
+
+def get_workspace_limit(res: Optional[Resources] = None) -> Optional[int]:
+    return _resolve(res).get_resource(Resources.WORKSPACE_LIMIT)

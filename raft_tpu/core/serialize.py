@@ -1,0 +1,82 @@
+"""Array serialization — parity with ``cpp/include/raft/core/serialize.hpp``.
+
+The reference serializes mdspans to the NumPy ``.npy`` format
+(``serialize_mdspan``/``deserialize_mdspan``, ``core/serialize.hpp:26,73``;
+writer in ``core/detail/mdspan_numpy_serializer.hpp``), used downstream for ANN
+index persistence.  Here the on-disk format is the same ``.npy`` stream, so
+artifacts interoperate with NumPy directly; scalars get the same header-framed
+encoding (``serialize_scalar``).  Index objects serialize as a directory of
+``.npy`` files plus a JSON metadata header (orbax-style layout, but zero-dep).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+from typing import Any, BinaryIO, Dict, Union
+
+import jax
+import numpy as np
+
+__all__ = [
+    "serialize_mdspan",
+    "deserialize_mdspan",
+    "serialize_scalar",
+    "deserialize_scalar",
+    "save_arrays",
+    "load_arrays",
+]
+
+
+def serialize_mdspan(stream: BinaryIO, array: Union[np.ndarray, jax.Array]) -> None:
+    """Write an array to ``stream`` in ``.npy`` format (``serialize.hpp:26``)."""
+    np.save(stream, np.asarray(array), allow_pickle=False)
+
+
+def deserialize_mdspan(stream: BinaryIO) -> np.ndarray:
+    """Read one ``.npy``-framed array from ``stream`` (``serialize.hpp:73``)."""
+    return np.load(stream, allow_pickle=False)
+
+
+def serialize_scalar(stream: BinaryIO, value: Any, dtype=None) -> None:
+    """Scalar with self-describing framing (``serialize_scalar`` parity)."""
+    arr = np.asarray(value, dtype=dtype)
+    np.save(stream, arr.reshape(()), allow_pickle=False)
+
+
+def deserialize_scalar(stream: BinaryIO) -> Any:
+    arr = np.load(stream, allow_pickle=False)
+    return arr[()]
+
+
+def save_arrays(path: Union[str, os.PathLike], arrays: Dict[str, Any], metadata: Dict[str, Any] = None) -> None:
+    """Persist a named bundle of arrays + JSON metadata under ``path``.
+
+    Layout: ``path/meta.json`` + one ``path/<name>.npy`` per array.  This is
+    the checkpoint/resume surface for index objects (the reference's
+    downstream use of ``serialize_mdspan``).
+    """
+    path = os.fspath(path)
+    os.makedirs(path, exist_ok=True)
+    names = sorted(arrays)
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump({"arrays": names, "metadata": metadata or {}}, f, indent=1)
+    for name in names:
+        with open(os.path.join(path, f"{name}.npy"), "wb") as f:
+            serialize_mdspan(f, arrays[name])
+
+
+def load_arrays(path: Union[str, os.PathLike]):
+    """Inverse of :func:`save_arrays` → ``(arrays_dict, metadata_dict)``.
+
+    Uses the native mmap fast path from :mod:`raft_tpu.utils.io` when the
+    extension is built, else ``np.load``.
+    """
+    path = os.fspath(path)
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    arrays = {}
+    for name in meta["arrays"]:
+        arrays[name] = np.load(os.path.join(path, f"{name}.npy"), allow_pickle=False)
+    return arrays, meta.get("metadata", {})

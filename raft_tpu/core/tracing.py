@@ -1,0 +1,78 @@
+"""Tracing ranges — parity with ``cpp/include/raft/core/nvtx.hpp``.
+
+RAFT provides RAII NVTX ranges (``common::nvtx::range``, ``core/nvtx.hpp:14-57``)
+compiled out unless ``RAFT_NVTX`` is on.  The TPU analog is
+``jax.profiler.TraceAnnotation`` (shows up in XProf/Perfetto timelines) plus
+``jax.named_scope`` so the annotation also lands in HLO names.  Enabled by
+default; set ``RAFT_TPU_TRACING=0`` to compile it out to a no-op.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from functools import wraps
+
+import jax
+
+__all__ = ["range", "annotate", "push_range", "pop_range"]
+
+_ENABLED = os.environ.get("RAFT_TPU_TRACING", "1") != "0"
+_tls = threading.local()
+
+
+def _stack() -> list:
+    # Per-thread like NVTX push/pop: annotations must not cross threads.
+    if not hasattr(_tls, "stack"):
+        _tls.stack = []
+    return _tls.stack
+
+
+@contextlib.contextmanager
+def range(fmt: str, *args):
+    """RAII-style range (``nvtx::range`` parity). Usage::
+
+        with tracing.range("select_k(batch=%d,k=%d)", batch, k):
+            ...
+    """
+    if not _ENABLED:
+        yield
+        return
+    name = (fmt % args) if args else fmt
+    with jax.profiler.TraceAnnotation(name), jax.named_scope(name):
+        yield
+
+
+def push_range(fmt: str, *args) -> None:
+    """Explicit push (``nvtx::push_range``); pair with :func:`pop_range`."""
+    if not _ENABLED:
+        return
+    name = (fmt % args) if args else fmt
+    cm = jax.profiler.TraceAnnotation(name)
+    cm.__enter__()
+    _stack().append(cm)
+
+
+def pop_range() -> None:
+    if not _ENABLED:
+        return
+    stack = _stack()
+    if stack:
+        stack.pop().__exit__(None, None, None)
+
+
+def annotate(name: str = None):
+    """Decorator form: annotate a whole function as a range."""
+
+    def deco(fn):
+        label = name or fn.__qualname__
+
+        @wraps(fn)
+        def wrapper(*args, **kwargs):
+            with range(label):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco

@@ -1,0 +1,44 @@
+"""Test harness config.
+
+RAFT validates MNMG logic without a real cluster via LocalCUDACluster
+(``raft-dask/raft_dask/tests/conftest.py:14-49``); the TPU analog is a virtual
+8-device CPU mesh via ``--xla_force_host_platform_device_count`` (SURVEY.md §4).
+Must run before jax initializes its backends, hence top of conftest.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# JAX_PLATFORMS=cpu via env is NOT honored here: the axon PJRT plugin's
+# sitecustomize register() overrides it. The programmatic config update wins
+# as long as it runs before backend initialization (verified).
+jax.config.update("jax_platforms", "cpu")
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def mesh8(devices):
+    return jax.sharding.Mesh(np.asarray(devices[:8]), ("shard",))
+
+
+@pytest.fixture(scope="session")
+def mesh2x4(devices):
+    return jax.sharding.Mesh(np.asarray(devices[:8]).reshape(2, 4), ("data", "shard"))
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,170 @@
+"""Core runtime tests — parity with ``cpp/tests/core/`` (handle, bitset,
+numpy_serializer, interruptible suites)."""
+
+import io
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import raft_tpu
+from raft_tpu.core import (
+    Bitset,
+    Bitmap,
+    DeviceResources,
+    LogicError,
+    Resources,
+    expects,
+    interruptible,
+    serialize_mdspan,
+    deserialize_mdspan,
+    save_arrays,
+    load_arrays,
+    wrap_array,
+)
+
+
+class TestResources:
+    def test_lazy_factory_runs_once(self):
+        res = Resources()
+        calls = []
+        res.add_resource_factory("thing", lambda r: calls.append(1) or "made")
+        assert res.get_resource("thing") == "made"
+        assert res.get_resource("thing") == "made"
+        assert len(calls) == 1
+
+    def test_copy_shares_cells(self):
+        res = Resources()
+        res.add_resource_factory("thing", lambda r: object())
+        a = res.get_resource("thing")
+        dup = res.copy()
+        assert dup.get_resource("thing") is a
+
+    def test_missing_resource_raises(self):
+        res = Resources()
+        with pytest.raises(raft_tpu.core.RaftError):
+            res.get_resource("no_such_slot")
+
+    def test_rng_key_stream_advances(self):
+        res = DeviceResources(seed=123)
+        k1, k2 = res.rng_key(), res.rng_key()
+        assert not np.array_equal(np.asarray(k1), np.asarray(k2))
+
+    def test_default_mesh(self):
+        res = Resources()
+        mesh = res.mesh
+        assert isinstance(mesh, jax.sharding.Mesh)
+        assert mesh.devices.size == len(jax.devices())
+
+    def test_comms_not_initialized_raises(self):
+        res = Resources()
+        with pytest.raises(LogicError):
+            raft_tpu.core.get_comms(res)
+
+    def test_thread_safety(self):
+        res = Resources()
+        made = []
+        res.add_resource_factory("slot", lambda r: made.append(1) or object())
+        out = []
+
+        def work():
+            out.append(res.get_resource("slot"))
+
+        threads = [threading.Thread(target=work) for _ in range(8)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        assert len(made) == 1
+        assert all(o is out[0] for o in out)
+
+
+class TestBitset:
+    def test_roundtrip(self, rng):
+        mask = rng.random(1000) < 0.3
+        bs = Bitset.from_bool_array(mask)
+        np.testing.assert_array_equal(np.asarray(bs.to_bool_array()), mask)
+        assert int(bs.count()) == mask.sum()
+
+    def test_create_set_flip(self):
+        bs = Bitset.create(70, default_value=False)
+        assert int(bs.count()) == 0
+        bs = bs.set(jnp.array([0, 33, 69]))
+        assert int(bs.count()) == 3
+        assert bool(bs.test(33))
+        assert not bool(bs.test(34))
+        flipped = bs.flip()
+        assert int(flipped.count()) == 67
+
+    def test_tail_masking(self):
+        bs = Bitset.create(33, default_value=True)
+        assert int(bs.count()) == 33
+
+    def test_and_or(self):
+        a = Bitset.from_bool_array(np.array([1, 0, 1, 0], bool))
+        b = Bitset.from_bool_array(np.array([1, 1, 0, 0], bool))
+        assert int((a & b).count()) == 1
+        assert int((a | b).count()) == 3
+
+    def test_bitmap(self):
+        bm = Bitmap.create_2d(4, 40, default_value=False)
+        bm = bm.set2(2, 5)
+        assert bool(bm.test2(2, 5))
+        assert not bool(bm.test2(2, 6))
+
+    def test_jit_compatible(self):
+        bs = Bitset.create(256, default_value=False)
+
+        @jax.jit
+        def f(b: Bitset):
+            return b.set(jnp.arange(10)).count()
+
+        assert int(f(bs)) == 10
+
+
+class TestSerialize:
+    def test_mdspan_roundtrip_npy(self, rng):
+        arr = rng.standard_normal((7, 5)).astype(np.float32)
+        buf = io.BytesIO()
+        serialize_mdspan(buf, jnp.asarray(arr))
+        buf.seek(0)
+        # the stream is genuine .npy — numpy can read it directly
+        out = np.load(buf)
+        np.testing.assert_array_equal(out, arr)
+
+    def test_bundle_roundtrip(self, tmp_path, rng):
+        arrays = {"a": rng.random((3, 3)).astype(np.float32), "b": np.arange(10)}
+        save_arrays(tmp_path / "ckpt", arrays, {"kind": "test", "k": 5})
+        loaded, meta = load_arrays(tmp_path / "ckpt")
+        assert meta["kind"] == "test" and meta["k"] == 5
+        np.testing.assert_array_equal(loaded["a"], arrays["a"])
+        np.testing.assert_array_equal(loaded["b"], arrays["b"])
+
+
+class TestInterruptible:
+    def test_cancel_then_yield_raises(self):
+        interruptible.clear()
+        interruptible.cancel()
+        with pytest.raises(interruptible.InterruptedException):
+            interruptible.yield_now()
+        interruptible.yield_now()  # flag cleared by the raise
+
+    def test_synchronize_passthrough(self):
+        interruptible.clear()
+        x = interruptible.synchronize(jnp.arange(4))
+        np.testing.assert_array_equal(np.asarray(x), np.arange(4))
+
+
+class TestArrayWrap:
+    def test_wrap_list(self):
+        x = wrap_array([[1.0, 2.0]], ndim=2)
+        assert x.shape == (1, 2)
+
+    def test_rank_check(self):
+        with pytest.raises(LogicError):
+            wrap_array(np.zeros((2, 2)), ndim=1)
+
+    def test_expects(self):
+        expects(True)
+        with pytest.raises(LogicError):
+            expects(False, "boom")
