@@ -1,0 +1,31 @@
+"""raft_tpu.linalg — dense linear algebra primitives.
+
+TPU-native analog of ``cpp/include/raft/linalg`` (SURVEY.md §2.3): the map /
+reduce / norm families lower to fused XLA VPU loops; BLAS-class ops to MXU
+``dot_general``; decompositions to ``lax.linalg`` plus hand-rolled Jacobi
+variants.
+"""
+
+from .elementwise import (
+    map, map_offset, unary_op, binary_op, ternary_op,
+    add, add_scalar, subtract, subtract_scalar, multiply, multiply_scalar,
+    divide, divide_scalar, power, power_scalar, sqrt,
+)
+from .reduce import (
+    Apply, reduce, coalesced_reduction, strided_reduction, map_reduce,
+    reduce_rows_by_key, reduce_cols_by_key, mean_squared_error,
+)
+from .norm import (
+    NormType, norm, row_norm, col_norm, normalize, row_normalize,
+    matrix_vector_op, binary_mult_skip_zero, binary_div_skip_zero,
+)
+from .blas import gemm, gemv, axpy, dot, transpose, init_eye
+from .decomp import (
+    eig_dc, eig_dc_selective, eig_jacobi, qr_get_q, qr_get_qr,
+    svd_qr, svd_eig, svd_jacobi, rsvd_fixed_rank,
+    lstsq_svd_qr, lstsq_eig, lstsq_qr, cholesky_r1_update,
+)
+from .pca import (
+    PcaSolver, PcaParams, PcaModel, pca_fit, pca_transform,
+    pca_fit_transform, pca_inverse_transform,
+)

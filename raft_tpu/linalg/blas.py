@@ -1,0 +1,83 @@
+"""BLAS-class ops — parity with ``cpp/include/raft/linalg/gemm.cuh:51-221``,
+``gemv.cuh``, ``axpy.cuh``, ``dot.cuh``, ``init.cuh``, ``transpose.cuh``.
+
+The reference routes these to cuBLAS/cuBLASLt; the TPU-native path is a single
+``jax.lax.dot_general`` that XLA tiles onto the MXU.  The knob that matters on
+TPU is the accumulation dtype: every matmul here takes
+``preferred_element_type`` (default f32) so bf16 inputs hit the MXU at full
+rate while accumulating in f32 — the moral equivalent of cuBLASLt's compute
+type selection in ``detail/cublaslt_wrappers.hpp``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core.array import wrap_array
+from ..core.errors import expects
+
+__all__ = ["gemm", "gemv", "axpy", "dot", "transpose", "init_eye"]
+
+
+def gemm(
+    a,
+    b,
+    *,
+    trans_a: bool = False,
+    trans_b: bool = False,
+    alpha: float = 1.0,
+    beta: float = 0.0,
+    c=None,
+    preferred_element_type=jnp.float32,
+):
+    """C = alpha·op(A)·op(B) + beta·C (``linalg::gemm``, ``gemm.cuh:51``)."""
+    a = wrap_array(a, ndim=2)
+    b = wrap_array(b, ndim=2)
+    if trans_a:
+        a = a.T
+    if trans_b:
+        b = b.T
+    expects(a.shape[1] == b.shape[0], f"gemm inner dims mismatch: {a.shape} x {b.shape}")
+    out = jnp.matmul(a, b, preferred_element_type=preferred_element_type)
+    if alpha != 1.0:
+        out = alpha * out
+    if beta != 0.0:
+        expects(c is not None, "beta != 0 requires C")
+        out = out + beta * wrap_array(c, ndim=2)
+    return out.astype(preferred_element_type if preferred_element_type is not None else out.dtype)
+
+
+def gemv(a, x, *, trans: bool = False, alpha: float = 1.0, beta: float = 0.0, y=None):
+    """y = alpha·op(A)·x + beta·y (``gemv.cuh``)."""
+    a = wrap_array(a, ndim=2)
+    x = wrap_array(x, ndim=1)
+    if trans:
+        a = a.T
+    out = alpha * jnp.matmul(a, x, preferred_element_type=jnp.float32)
+    if beta != 0.0:
+        expects(y is not None, "beta != 0 requires y")
+        out = out + beta * wrap_array(y, ndim=1)
+    return out
+
+
+def axpy(alpha: float, x, y):
+    """y ← alpha·x + y (``axpy.cuh``)."""
+    return alpha * wrap_array(x) + wrap_array(y)
+
+
+def dot(x, y):
+    """Inner product (``dot.cuh``)."""
+    x, y = wrap_array(x, ndim=1), wrap_array(y, ndim=1)
+    return jnp.dot(x, y, preferred_element_type=jnp.float32)
+
+
+def transpose(a):
+    """Out-of-place transpose (``transpose.cuh``; XLA fuses the layout swap)."""
+    return wrap_array(a, ndim=2).T
+
+
+def init_eye(n: int, m: Optional[int] = None, dtype=jnp.float32):
+    """Identity init (``init.cuh``)."""
+    return jnp.eye(n, m, dtype=dtype)

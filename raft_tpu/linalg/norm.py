@@ -1,0 +1,115 @@
+"""Norms / normalization / broadcast ops — parity with
+``cpp/include/raft/linalg/norm.cuh`` (+``norm_types.hpp``), ``normalize.cuh``,
+``matrix_vector_op.cuh``, ``matrix_vector.cuh``.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable
+
+import jax.numpy as jnp
+
+from ..core.array import wrap_array
+from ..core.errors import expects
+from .reduce import Apply
+
+__all__ = [
+    "NormType",
+    "norm",
+    "row_norm",
+    "col_norm",
+    "normalize",
+    "row_normalize",
+    "matrix_vector_op",
+    "binary_mult_skip_zero",
+    "binary_div_skip_zero",
+]
+
+
+class NormType(enum.Enum):
+    """``raft::linalg::NormType`` (``norm_types.hpp``)."""
+
+    L1Norm = "l1"
+    L2Norm = "l2"          # sum of squares (NOT rooted), as in the reference
+    LinfNorm = "linf"
+
+
+def norm(
+    data,
+    norm_type: NormType = NormType.L2Norm,
+    apply: Apply = Apply.ALONG_ROWS,
+    root: bool = False,
+):
+    """Row/col norms (``linalg::norm``, ``norm.cuh``).  Note the reference's
+    L2 norm is the *sum of squares*; pass ``root=True`` for sqrt epilogue
+    (the reference's ``fin_op=sqrt_op``)."""
+    data = wrap_array(data, ndim=2)
+    axis = 1 if apply == Apply.ALONG_ROWS else 0
+    if norm_type == NormType.L1Norm:
+        out = jnp.sum(jnp.abs(data), axis=axis)
+    elif norm_type == NormType.L2Norm:
+        out = jnp.sum(data * data, axis=axis)
+    else:
+        out = jnp.max(jnp.abs(data), axis=axis)
+    return jnp.sqrt(out) if (root and norm_type == NormType.L2Norm) else out
+
+
+def row_norm(data, norm_type: NormType = NormType.L2Norm, root: bool = False):
+    return norm(data, norm_type, Apply.ALONG_ROWS, root)
+
+
+def col_norm(data, norm_type: NormType = NormType.L2Norm, root: bool = False):
+    return norm(data, norm_type, Apply.ALONG_COLUMNS, root)
+
+
+def normalize(data, norm_type: NormType = NormType.L2Norm, eps: float = 1e-10):
+    """Row-normalize (``linalg::normalize``/``row_normalize``,
+    ``normalize.cuh``).  L2 uses the rooted norm, as the reference does."""
+    data = wrap_array(data, ndim=2)
+    if norm_type == NormType.L2Norm:
+        denom = jnp.sqrt(jnp.sum(data * data, axis=1, keepdims=True))
+    elif norm_type == NormType.L1Norm:
+        denom = jnp.sum(jnp.abs(data), axis=1, keepdims=True)
+    else:
+        denom = jnp.max(jnp.abs(data), axis=1, keepdims=True)
+    return jnp.where(denom > eps, data / denom, data)
+
+
+row_normalize = normalize
+
+
+def matrix_vector_op(matrix, vector, op: Callable = jnp.add, along_rows: bool = True):
+    """Broadcast a vector across a matrix (``matrix_vector_op.cuh``).
+
+    ``along_rows=True``: vector has length n_cols and is applied to every row
+    (the reference's ``bcastAlongRows``).
+    """
+    matrix = wrap_array(matrix, ndim=2)
+    vector = wrap_array(vector, ndim=1)
+    if along_rows:
+        expects(vector.shape[0] == matrix.shape[1], "vector length must equal n_cols")
+        return op(matrix, vector[None, :])
+    expects(vector.shape[0] == matrix.shape[0], "vector length must equal n_rows")
+    return op(matrix, vector[:, None])
+
+
+def binary_mult_skip_zero(matrix, vector, along_rows: bool = True):
+    """``matrix_vector.cuh`` helper: multiply, treating 0 entries as 1."""
+    safe = jnp.where(wrap_array(vector, ndim=1) == 0, 1, vector)
+    return matrix_vector_op(matrix, safe, jnp.multiply, along_rows)
+
+
+def binary_div_skip_zero(matrix, vector, along_rows: bool = True, return_zero: bool = False):
+    """``matrix_vector.cuh`` helper: divide, skipping zero divisors.
+
+    ``return_zero=True`` zeroes the output where the divisor is ~0 (the
+    reference's ``bcastAlongRows`` variant used by kmeans centroid updates).
+    """
+    vector = wrap_array(vector, ndim=1)
+    safe = jnp.where(vector == 0, 1, vector)
+    out = matrix_vector_op(matrix, safe, jnp.divide, along_rows)
+    if return_zero:
+        mask = (vector == 0)[None, :] if along_rows else (vector == 0)[:, None]
+        out = jnp.where(mask, 0, out)
+    return out

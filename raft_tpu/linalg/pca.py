@@ -1,0 +1,89 @@
+"""PCA — parity with ``cpp/include/raft/linalg/pca.cuh:42,87`` (+
+``pca_types.hpp``), newly promoted into RAFT from cuML.
+
+Covariance + eigendecomposition path: center, cov = XᵀX/(n−1), eigh, project.
+Everything is MXU matmuls + one small eigh.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.array import wrap_array
+
+__all__ = ["PcaSolver", "PcaParams", "PcaModel", "pca_fit", "pca_transform", "pca_fit_transform", "pca_inverse_transform"]
+
+
+class PcaSolver(enum.Enum):
+    """``pca_types.hpp`` solver enum (COV_EIG_DQ / COV_EIG_JACOBI)."""
+
+    COV_EIG_DQ = "eig_dc"
+    COV_EIG_JACOBI = "eig_jacobi"
+
+
+class PcaParams(NamedTuple):
+    n_components: int
+    solver: PcaSolver = PcaSolver.COV_EIG_DQ
+    whiten: bool = False
+
+
+class PcaModel(NamedTuple):
+    components: jax.Array        # (n_components, n_features)
+    explained_variance: jax.Array
+    explained_variance_ratio: jax.Array
+    singular_values: jax.Array
+    mean: jax.Array
+    noise_variance: jax.Array
+
+
+def pca_fit(data, params: PcaParams) -> PcaModel:
+    """Fit PCA (``pca_fit``, ``pca.cuh:42``)."""
+    x = wrap_array(data, ndim=2)
+    n, d = x.shape
+    mean = jnp.mean(x, axis=0)
+    xc = x - mean[None, :]
+    cov = jnp.matmul(xc.T, xc, preferred_element_type=jnp.float32) / (n - 1)
+    if params.solver == PcaSolver.COV_EIG_JACOBI:
+        from .decomp import eig_jacobi
+
+        vals, vecs = eig_jacobi(cov)
+    else:
+        vals, vecs = jnp.linalg.eigh(cov)
+    vals = jnp.maximum(vals[::-1], 0.0)  # descending
+    vecs = vecs[:, ::-1]
+    k = params.n_components
+    total_var = jnp.sum(vals)
+    noise = jnp.mean(vals[k:]) if k < d else jnp.asarray(0.0, vals.dtype)
+    return PcaModel(
+        components=vecs[:, :k].T,
+        explained_variance=vals[:k],
+        explained_variance_ratio=vals[:k] / jnp.where(total_var > 0, total_var, 1.0),
+        singular_values=jnp.sqrt(vals[:k] * (n - 1)),
+        mean=mean,
+        noise_variance=noise,
+    )
+
+
+def pca_transform(data, model: PcaModel, params: PcaParams):
+    x = wrap_array(data, ndim=2)
+    proj = jnp.matmul(x - model.mean[None, :], model.components.T, preferred_element_type=jnp.float32)
+    if params.whiten:
+        proj = proj / jnp.sqrt(jnp.where(model.explained_variance > 0, model.explained_variance, 1.0))[None, :]
+    return proj
+
+
+def pca_fit_transform(data, params: PcaParams):
+    """``pca_fit_transform`` (``pca.cuh:87``)."""
+    model = pca_fit(data, params)
+    return pca_transform(data, model, params), model
+
+
+def pca_inverse_transform(proj, model: PcaModel, params: PcaParams):
+    proj = wrap_array(proj, ndim=2)
+    if params.whiten:
+        proj = proj * jnp.sqrt(jnp.where(model.explained_variance > 0, model.explained_variance, 1.0))[None, :]
+    return jnp.matmul(proj, model.components, preferred_element_type=jnp.float32) + model.mean[None, :]

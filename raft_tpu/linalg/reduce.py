@@ -1,0 +1,123 @@
+"""Reduction family — parity with ``cpp/include/raft/linalg/reduce.cuh:63,148``,
+``coalesced_reduction.cuh``, ``strided_reduction.cuh``, ``map_reduce.cuh``,
+``reduce_rows_by_key.cuh``, ``reduce_cols_by_key.cuh``,
+``mean_squared_error.cuh``.
+
+The reference dispatches on (layout × reduction direction) into
+thin/medium/thick tiled kernels (``detail/coalesced_reduction-inl.cuh:22``).
+On TPU a reduction lowers to an XLA ``reduce`` the compiler tiles onto the VPU
+— the policy machinery disappears; what's kept is the op algebra
+(``main_op`` elementwise transform → ``reduce_op`` associative combine →
+``final_op`` epilogue) and the ``Apply`` direction enum.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.array import wrap_array
+from ..core.errors import expects
+
+__all__ = [
+    "Apply",
+    "reduce",
+    "coalesced_reduction",
+    "strided_reduction",
+    "map_reduce",
+    "reduce_rows_by_key",
+    "reduce_cols_by_key",
+    "mean_squared_error",
+]
+
+
+class Apply(enum.Enum):
+    """Reduction direction (``linalg_types.hpp`` ``Apply``)."""
+
+    ALONG_ROWS = "along_rows"
+    ALONG_COLUMNS = "along_columns"
+
+
+def _identity(x):
+    return x
+
+
+def reduce(
+    data,
+    *,
+    apply: Apply = Apply.ALONG_ROWS,
+    init=0,
+    main_op: Callable = _identity,
+    reduce_op: Callable = jnp.add,
+    final_op: Callable = _identity,
+):
+    """General row/col reduction (``linalg::reduce``, ``reduce.cuh:148``).
+
+    ``ALONG_ROWS`` reduces each row to a scalar (output length = n_rows),
+    matching the reference's row-major/along-rows coalesced path.
+    """
+    data = wrap_array(data, ndim=2)
+    axis = 1 if apply == Apply.ALONG_ROWS else 0
+    mapped = main_op(data)
+    if reduce_op in (jnp.add, jnp.sum):
+        acc = jnp.sum(mapped, axis=axis)
+    elif reduce_op in (jnp.minimum, jnp.min):
+        acc = jnp.min(mapped, axis=axis)
+    elif reduce_op in (jnp.maximum, jnp.max):
+        acc = jnp.max(mapped, axis=axis)
+    else:  # arbitrary associative functor: let XLA build the reduction
+        acc = jax.lax.reduce(mapped, jnp.asarray(init, mapped.dtype), lambda a, b: reduce_op(a, b), (axis,))
+        return final_op(acc)
+    if init != 0:
+        acc = reduce_op(acc, jnp.asarray(init, acc.dtype))
+    return final_op(acc)
+
+
+def coalesced_reduction(data, **kwargs):
+    """Reduce along the contiguous (last) dimension
+    (``coalesced_reduction.cuh``)."""
+    return reduce(data, apply=Apply.ALONG_ROWS, **kwargs)
+
+
+def strided_reduction(data, **kwargs):
+    """Reduce along the strided (first) dimension (``strided_reduction.cuh``)."""
+    return reduce(data, apply=Apply.ALONG_COLUMNS, **kwargs)
+
+
+def map_reduce(fn: Callable, reduce_op: Callable, *arrays, init=0):
+    """Fused map→reduce over flat arrays (``map_reduce.cuh``)."""
+    arrays = [wrap_array(a) for a in arrays]
+    mapped = fn(*arrays)
+    flat = mapped.reshape(-1)
+    if reduce_op in (jnp.add, jnp.sum):
+        return jnp.sum(flat) + jnp.asarray(init, flat.dtype)
+    return jax.lax.reduce(flat, jnp.asarray(init, flat.dtype), lambda a, b: reduce_op(a, b), (0,))
+
+
+def reduce_rows_by_key(data, keys, n_unique_keys: int, weights=None):
+    """Sum rows sharing a key (``reduce_rows_by_key.cuh``): out[k] = Σ rows
+    with keys[i]==k.  Segment-sum formulation (TPU-friendly scatter-add)."""
+    data = wrap_array(data, ndim=2)
+    keys = wrap_array(keys, ndim=1)
+    expects(keys.shape[0] == data.shape[0], "one key per row required")
+    if weights is not None:
+        data = data * wrap_array(weights, ndim=1)[:, None]
+    return jax.ops.segment_sum(data, keys, num_segments=n_unique_keys)
+
+
+def reduce_cols_by_key(data, keys, n_unique_keys: int):
+    """Sum columns sharing a key (``reduce_cols_by_key.cuh``)."""
+    data = wrap_array(data, ndim=2)
+    keys = wrap_array(keys, ndim=1)
+    expects(keys.shape[0] == data.shape[1], "one key per column required")
+    return jax.ops.segment_sum(data.T, keys, num_segments=n_unique_keys).T
+
+
+def mean_squared_error(a, b, weight: float = 1.0):
+    """Weighted MSE (``mean_squared_error.cuh``)."""
+    a, b = wrap_array(a), wrap_array(b)
+    diff = a - b
+    return weight * jnp.mean(diff * diff)

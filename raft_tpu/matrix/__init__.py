@@ -1,0 +1,14 @@
+"""raft_tpu.matrix — matrix ops incl. the select_k top-k keystone.
+
+TPU-native analog of ``cpp/include/raft/matrix`` (SURVEY.md §2.4).
+"""
+
+from .select_k import SelectAlgo, select_k
+from .gather import gather, gather_if, scatter
+from .ops import (
+    argmax, argmin, col_wise_sort, sample_rows,
+    get_diagonal, set_diagonal, invert_diagonal,
+    linewise_op, reverse, sign_flip, slice, shift_rows,
+    threshold, lower_triangular, upper_triangular, ratio, reciprocal,
+    eye, fill,
+)

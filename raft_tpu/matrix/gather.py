@@ -1,0 +1,49 @@
+"""Gather / scatter — parity with ``cpp/include/raft/matrix/gather.cuh:43-458``
+and ``matrix/scatter.cuh`` (+ ``detail/gather_inplace.cuh`` /
+``detail/scatter_inplace.cuh``).
+
+XLA gather/scatter are native ops; the "inplace/buffered" CUDA variants exist
+only to bound workspace — under XLA, donation covers that.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+from ..core.array import wrap_array
+from ..core.errors import expects
+
+__all__ = ["gather", "gather_if", "scatter"]
+
+
+def gather(matrix, row_map, transform_op: Optional[Callable] = None):
+    """out[i, :] = in[map[i], :] (``matrix::gather``, ``gather.cuh:43``),
+    with the optional map-transform overloads folded in."""
+    matrix = wrap_array(matrix, ndim=2)
+    row_map = wrap_array(row_map, ndim=1)
+    if transform_op is not None:
+        row_map = transform_op(row_map)
+    return jnp.take(matrix, row_map, axis=0)
+
+
+def gather_if(matrix, row_map, stencil, pred_op: Callable, fallback=0.0):
+    """Conditional gather (``gather_if``): rows where ``pred_op(stencil)`` is
+    false produce ``fallback`` (the reference leaves them untouched in-place;
+    functionally that's a fill)."""
+    matrix = wrap_array(matrix, ndim=2)
+    row_map = wrap_array(row_map, ndim=1)
+    stencil = wrap_array(stencil, ndim=1)
+    expects(stencil.shape[0] == row_map.shape[0], "stencil must match map length")
+    out = jnp.take(matrix, row_map, axis=0)
+    mask = pred_op(stencil).astype(bool)
+    return jnp.where(mask[:, None], out, jnp.asarray(fallback, out.dtype))
+
+
+def scatter(matrix, row_map):
+    """out[map[i], :] = in[i, :] (``matrix::scatter``, ``scatter.cuh``)."""
+    matrix = wrap_array(matrix, ndim=2)
+    row_map = wrap_array(row_map, ndim=1)
+    expects(row_map.shape[0] == matrix.shape[0], "one destination per row required")
+    return jnp.zeros_like(matrix).at[row_map].set(matrix)

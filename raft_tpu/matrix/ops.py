@@ -1,0 +1,149 @@
+"""Matrix element/structure ops — parity with the small ``cpp/include/raft/matrix``
+headers: ``argmax.cuh:28`` / ``argmin.cuh``, ``col_wise_sort.cuh``,
+``sample_rows.cuh:30``, ``copy.cuh``, ``diagonal.cuh``, ``init.cuh``,
+``linewise_op.cuh``, ``norm.cuh``, ``power.cuh``, ``ratio.cuh``,
+``reciprocal.cuh``, ``reverse.cuh``, ``shift.cuh``, ``sign_flip.cuh``,
+``slice.cuh``, ``sqrt.cuh``, ``threshold.cuh``, ``triangular.cuh``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.array import wrap_array
+from ..core.errors import expects
+
+__all__ = [
+    "argmax", "argmin", "col_wise_sort", "sample_rows",
+    "get_diagonal", "set_diagonal", "invert_diagonal",
+    "linewise_op", "reverse", "sign_flip", "slice", "shift_rows",
+    "threshold", "lower_triangular", "upper_triangular", "ratio", "reciprocal",
+    "eye", "fill",
+]
+
+
+def argmax(matrix) -> jax.Array:
+    """Per-row argmax (``matrix/argmax.cuh:28``)."""
+    return jnp.argmax(wrap_array(matrix, ndim=2), axis=1).astype(jnp.int32)
+
+
+def argmin(matrix) -> jax.Array:
+    """Per-row argmin (``matrix/argmin.cuh``)."""
+    return jnp.argmin(wrap_array(matrix, ndim=2), axis=1).astype(jnp.int32)
+
+
+def col_wise_sort(matrix, ascending: bool = True) -> Tuple[jax.Array, jax.Array]:
+    """Sort each column, returning (sorted, source-row indices)
+    (``col_wise_sort.cuh``)."""
+    matrix = wrap_array(matrix, ndim=2)
+    key = matrix if ascending else -matrix
+    order = jnp.argsort(key, axis=0)
+    return jnp.take_along_axis(matrix, order, axis=0), order.astype(jnp.int32)
+
+
+def sample_rows(matrix, n_samples: int, key=None, replace: bool = False):
+    """Uniform row subsample (``sample_rows.cuh:30`` w/ ``excess_subsample``)."""
+    matrix = wrap_array(matrix, ndim=2)
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    idx = jax.random.choice(key, matrix.shape[0], shape=(n_samples,), replace=replace)
+    return jnp.take(matrix, idx, axis=0)
+
+
+def get_diagonal(matrix) -> jax.Array:
+    """``diagonal.cuh`` getter."""
+    return jnp.diagonal(wrap_array(matrix, ndim=2))
+
+
+def set_diagonal(matrix, values):
+    m = wrap_array(matrix, ndim=2)
+    values = wrap_array(values, ndim=1)
+    n = min(m.shape)
+    return m.at[jnp.arange(n), jnp.arange(n)].set(values[:n])
+
+
+def invert_diagonal(matrix):
+    """``diagonal.cuh`` inverse-in-place analog."""
+    m = wrap_array(matrix, ndim=2)
+    d = jnp.diagonal(m)
+    return set_diagonal(m, 1.0 / d)
+
+
+def linewise_op(matrix, vectors, op: Callable, along_lines: bool = True):
+    """Apply op(row_element, vec_element) across lines (``linewise_op.cuh``,
+    the row/col broadcast engine behind matrix_vector_op)."""
+    from ..linalg.norm import matrix_vector_op
+
+    return matrix_vector_op(matrix, vectors, op, along_rows=along_lines)
+
+
+def reverse(matrix, along_rows: bool = True):
+    """``reverse.cuh``: flip each row (or column)."""
+    m = wrap_array(matrix, ndim=2)
+    return m[:, ::-1] if along_rows else m[::-1, :]
+
+
+def sign_flip(matrix):
+    """``sign_flip.cuh``: flip column signs so the max-|x| entry per column is
+    positive (deterministic eigenvector orientation)."""
+    m = wrap_array(matrix, ndim=2)
+    idx = jnp.argmax(jnp.abs(m), axis=0)
+    signs = jnp.sign(m[idx, jnp.arange(m.shape[1])])
+    signs = jnp.where(signs == 0, 1.0, signs)
+    return m * signs[None, :]
+
+
+def slice(matrix, row_range: Tuple[int, int], col_range: Tuple[int, int]):
+    """``slice.cuh``: submatrix copy."""
+    m = wrap_array(matrix, ndim=2)
+    (r0, r1), (c0, c1) = row_range, col_range
+    expects(0 <= r0 <= r1 <= m.shape[0] and 0 <= c0 <= c1 <= m.shape[1], "slice out of bounds")
+    return m[r0:r1, c0:c1]
+
+
+def shift_rows(matrix, offset: int, fill_value=0.0):
+    """``shift.cuh``: shift columns right by ``offset`` filling with
+    ``fill_value`` (used to prepend self-indices in ANN graphs)."""
+    m = wrap_array(matrix, ndim=2)
+    return jnp.roll(m, offset, axis=1).at[:, :offset].set(fill_value) if offset > 0 else m
+
+
+def threshold(matrix, value, set_to=0.0, keep_above: bool = True):
+    """``threshold.cuh``: zero out entries below (or above) a threshold."""
+    m = wrap_array(matrix)
+    mask = m >= value if keep_above else m <= value
+    return jnp.where(mask, m, jnp.asarray(set_to, m.dtype))
+
+
+def lower_triangular(matrix):
+    """``triangular.cuh``."""
+    return jnp.tril(wrap_array(matrix, ndim=2))
+
+
+def upper_triangular(matrix):
+    return jnp.triu(wrap_array(matrix, ndim=2))
+
+
+def ratio(matrix):
+    """``ratio.cuh``: each element divided by the total sum."""
+    m = wrap_array(matrix)
+    return m / jnp.sum(m)
+
+
+def reciprocal(matrix, scalar: float = 1.0, thres: float = 0.0):
+    """``reciprocal.cuh``: scalar/x with small-value guard."""
+    m = wrap_array(matrix)
+    return jnp.where(jnp.abs(m) > thres, scalar / m, jnp.zeros_like(m))
+
+
+def eye(n: int, m: Optional[int] = None, dtype=jnp.float32):
+    """``init.cuh`` identity."""
+    return jnp.eye(n, m, dtype=dtype)
+
+
+def fill(shape, value, dtype=jnp.float32):
+    """``init.cuh`` fill."""
+    return jnp.full(shape, value, dtype=dtype)

@@ -1,0 +1,2 @@
+"""raft_tpu.ops — kernel-level implementations (Pallas + XLA formulations)
+backing the public primitives.  Analog of the reference's ``detail/`` layer."""

@@ -1,0 +1,16 @@
+"""raft_tpu.random — counter-based RNG, distributions, data + graph generators.
+
+TPU-native analog of ``cpp/include/raft/random`` (SURVEY.md §2.6).  JAX's
+stateless key-based PRNG is the natural match for RAFT's counter-based
+Philox/PCG design.
+"""
+
+from .rng import (
+    GeneratorType, RngState,
+    uniform, uniform_int, normal, normal_int, normal_table, fill,
+    bernoulli, scaled_bernoulli, gumbel, lognormal, logistic,
+    exponential, rayleigh, laplace, discrete,
+    sample_without_replacement, excess_subsample,
+)
+from .datagen import make_blobs, make_regression, multi_variable_gaussian, permute
+from .rmat import rmat_rectangular_gen, rmat

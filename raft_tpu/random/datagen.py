@@ -1,0 +1,96 @@
+"""Synthetic data generation — parity with
+``cpp/include/raft/random/make_blobs.cuh:58,126`` (GMM cluster generator),
+``make_regression.cuh``, ``multi_variable_gaussian.cuh``, ``permute.cuh``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.array import wrap_array
+from .rng import RngState, _key_of
+
+__all__ = ["make_blobs", "make_regression", "multi_variable_gaussian", "permute"]
+
+
+def make_blobs(
+    rng,
+    n_samples: int,
+    n_features: int,
+    n_clusters: int = 5,
+    cluster_std: float = 1.0,
+    center_box: Tuple[float, float] = (-10.0, 10.0),
+    centers=None,
+    shuffle: bool = True,
+    dtype=jnp.float32,
+) -> Tuple[jax.Array, jax.Array]:
+    """Gaussian-mixture blobs → (X, labels) (``make_blobs.cuh:58``)."""
+    key = _key_of(rng)
+    k_centers, k_assign, k_noise, k_shuffle = jax.random.split(key, 4)
+    if centers is None:
+        centers = jax.random.uniform(
+            k_centers, (n_clusters, n_features), dtype=dtype,
+            minval=center_box[0], maxval=center_box[1],
+        )
+    else:
+        centers = wrap_array(centers, ndim=2, dtype=dtype)
+        n_clusters = centers.shape[0]
+    labels = jax.random.randint(k_assign, (n_samples,), 0, n_clusters)
+    noise = cluster_std * jax.random.normal(k_noise, (n_samples, n_features), dtype=dtype)
+    x = jnp.take(centers, labels, axis=0) + noise
+    if shuffle:
+        perm = jax.random.permutation(k_shuffle, n_samples)
+        x, labels = x[perm], labels[perm]
+    return x, labels.astype(jnp.int32)
+
+
+def make_regression(
+    rng,
+    n_samples: int,
+    n_features: int,
+    n_informative: Optional[int] = None,
+    n_targets: int = 1,
+    bias: float = 0.0,
+    noise: float = 0.0,
+    shuffle: bool = True,
+    dtype=jnp.float32,
+):
+    """Linear-model regression data → (X, y, coef) (``make_regression.cuh``)."""
+    n_informative = n_features if n_informative is None else min(n_informative, n_features)
+    key = _key_of(rng)
+    k_x, k_w, k_n, k_s = jax.random.split(key, 4)
+    x = jax.random.normal(k_x, (n_samples, n_features), dtype=dtype)
+    coef = jnp.zeros((n_features, n_targets), dtype=dtype)
+    w = 100.0 * jax.random.uniform(k_w, (n_informative, n_targets), dtype=dtype)
+    coef = coef.at[:n_informative].set(w)
+    y = jnp.matmul(x, coef, preferred_element_type=jnp.float32).astype(dtype) + bias
+    if noise > 0:
+        y = y + noise * jax.random.normal(k_n, y.shape, dtype=dtype)
+    if shuffle:
+        perm = jax.random.permutation(k_s, n_samples)
+        x, y = x[perm], y[perm]
+    return x, y.squeeze(-1) if n_targets == 1 else y, coef
+
+
+def multi_variable_gaussian(rng, n_samples: int, mean, cov):
+    """Samples from N(mean, cov) (``multi_variable_gaussian.cuh`` — the
+    reference factors cov with cuSOLVER potrf; here ``jax.random`` does the
+    Cholesky internally)."""
+    mean = wrap_array(mean, ndim=1)
+    cov = wrap_array(cov, ndim=2)
+    return jax.random.multivariate_normal(_key_of(rng), mean, cov, (n_samples,), dtype=mean.dtype)
+
+
+def permute(rng, array_or_n, rows: bool = True):
+    """Random permutation of rows (or an index permutation)
+    (``random/permute.cuh``)."""
+    key = _key_of(rng)
+    if isinstance(array_or_n, int):
+        return jax.random.permutation(key, array_or_n)
+    arr = wrap_array(array_or_n)
+    axis = 0 if rows else 1
+    perm = jax.random.permutation(key, arr.shape[axis])
+    return jnp.take(arr, perm, axis=axis), perm

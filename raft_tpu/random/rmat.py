@@ -1,0 +1,71 @@
+"""R-MAT rectangular graph generator — parity with
+``cpp/include/raft/random/rmat_rectangular_generator.cuh`` (kernel
+``detail/rmat_rectangular_generator.cuh:67``: one thread per edge, per-thread
+generator stream, quadrant descent over the scale levels) and the pylibraft
+binding ``random/rmat_rectangular_generator.pyx:69``.
+
+TPU formulation: the quadrant descent is vectorized over all edges at once —
+``max(r_scale, c_scale)`` rounds of a 4-way categorical pick, each round
+appending one bit to the row/col ids.  No per-edge loop; one (n_edges × levels)
+uniform tensor drives everything.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.array import wrap_array
+from ..core.errors import expects
+from .rng import _key_of
+
+__all__ = ["rmat_rectangular_gen", "rmat"]
+
+
+def rmat_rectangular_gen(
+    rng,
+    n_edges: int,
+    theta,
+    r_scale: int,
+    c_scale: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Generate (src, dst) of an R-MAT graph with 2^r_scale × 2^c_scale
+    adjacency.  ``theta`` is ``(max_scale, 4)`` (or flat ``4*max_scale``)
+    per-level quadrant probabilities [a, b, c, d], exactly the reference's
+    layout."""
+    max_scale = max(r_scale, c_scale)
+    theta = wrap_array(theta).reshape(max_scale, 4).astype(jnp.float32)
+    # Normalize each level (the reference requires caller-normalized theta;
+    # we tolerate unnormalized input).
+    theta = theta / jnp.sum(theta, axis=1, keepdims=True)
+
+    key = _key_of(rng)
+    u = jax.random.uniform(key, (n_edges, max_scale))
+
+    # Per level: cumulative [a, a+b, a+b+c] thresholds → quadrant in {0,1,2,3}
+    cum = jnp.cumsum(theta, axis=1)  # (L, 4)
+    q = (u[:, :, None] > cum[None, :, :3]).sum(axis=2)  # (n_edges, L) in 0..3
+
+    # Quadrant bits: row bit = q >> 1, col bit = q & 1 (a=00, b=01, c=10, d=11)
+    # int32 ids: scales beyond 31 bits would need jax_enable_x64.
+    expects(max_scale <= 31, "rmat scales > 31 require 64-bit ids (enable jax x64)")
+    row_bits = (q >> 1).astype(jnp.int32)
+    col_bits = (q & 1).astype(jnp.int32)
+
+    # For rectangular output, only the last r_scale (c_scale) levels contribute
+    # bits to rows (cols), matching detail/rmat_rectangular_generator.cuh:67.
+    levels = jnp.arange(max_scale)
+    r_shift = jnp.where(levels >= max_scale - r_scale, (max_scale - 1 - levels), -1)
+    c_shift = jnp.where(levels >= max_scale - c_scale, (max_scale - 1 - levels), -1)
+    src = jnp.sum(jnp.where(r_shift >= 0, row_bits << jnp.maximum(r_shift, 0), 0), axis=1)
+    dst = jnp.sum(jnp.where(c_shift >= 0, col_bits << jnp.maximum(c_shift, 0), 0), axis=1)
+    return src, dst
+
+
+def rmat(rng, n_edges: int, theta, r_scale: int, c_scale: int) -> jax.Array:
+    """pylibraft-style entry (``rmat_rectangular_generator.pyx:69``): returns
+    an ``(n_edges, 2)`` int64 edge list."""
+    src, dst = rmat_rectangular_gen(rng, n_edges, theta, r_scale, c_scale)
+    return jnp.stack([src, dst], axis=1)

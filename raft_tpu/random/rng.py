@@ -1,0 +1,173 @@
+"""RNG state + distributions — parity with ``cpp/include/raft/random/rng.cuh:43-503``
+and ``rng_state.hpp:19`` (``RngState``, ``GeneratorType{GenPhilox,GenPC}``).
+
+RAFT's generators are counter-based and stateless per call (``detail/rng_device.cuh``)
+— exactly JAX's PRNG model, so ``RngState`` maps to a key plus a split counter
+and every distribution is a pure function of (key, shape).  Philox is JAX's
+default threefry-family generator; the PCG option maps to ``rbg`` when needed.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.array import wrap_array
+from ..core.errors import expects
+
+__all__ = [
+    "GeneratorType",
+    "RngState",
+    "uniform",
+    "uniform_int",
+    "normal",
+    "normal_int",
+    "normal_table",
+    "fill",
+    "bernoulli",
+    "scaled_bernoulli",
+    "gumbel",
+    "lognormal",
+    "logistic",
+    "exponential",
+    "rayleigh",
+    "laplace",
+    "discrete",
+    "sample_without_replacement",
+    "excess_subsample",
+]
+
+
+class GeneratorType(enum.Enum):
+    """``rng_state.hpp:29``."""
+
+    GenPhilox = "philox"
+    GenPC = "pcg"
+
+
+class RngState:
+    """Seed + stream counter (``RngState``, ``rng_state.hpp:19``).
+
+    ``next_key()`` advances the subsequence, giving each kernel call its own
+    independent counter-based stream like the reference's per-call
+    ``rng_state.advance()``.
+    """
+
+    def __init__(self, seed: int = 0, generator: GeneratorType = GeneratorType.GenPhilox):
+        self.seed = int(seed)
+        self.generator = generator
+        self._subseq = 0
+        impl = "threefry2x32" if generator == GeneratorType.GenPhilox else "rbg"
+        self._base = jax.random.key(self.seed, impl=impl)
+
+    def next_key(self) -> jax.Array:
+        self._subseq += 1
+        return jax.random.fold_in(self._base, self._subseq)
+
+    def advance(self, n: int = 1) -> None:
+        self._subseq += n
+
+
+def _key_of(rng) -> jax.Array:
+    if isinstance(rng, RngState):
+        return rng.next_key()
+    return rng  # assume a jax PRNG key
+
+
+def uniform(rng, shape, low=0.0, high=1.0, dtype=jnp.float32):
+    """U[low, high) (``rng.cuh`` ``uniform``)."""
+    return jax.random.uniform(_key_of(rng), shape, dtype=dtype, minval=low, maxval=high)
+
+
+def uniform_int(rng, shape, low: int, high: int, dtype=jnp.int32):
+    """Uniform integers in [low, high) (``uniformInt``)."""
+    return jax.random.randint(_key_of(rng), shape, low, high, dtype=dtype)
+
+
+def normal(rng, shape, mu=0.0, sigma=1.0, dtype=jnp.float32):
+    return mu + sigma * jax.random.normal(_key_of(rng), shape, dtype=dtype)
+
+
+def normal_int(rng, shape, mu: int, sigma: int, dtype=jnp.int32):
+    """Rounded normal (``normalInt``)."""
+    return jnp.round(normal(rng, shape, float(mu), float(sigma))).astype(dtype)
+
+
+def normal_table(rng, n_rows: int, mu_vec, sigma_vec=None, sigma: float = 1.0, dtype=jnp.float32):
+    """Rows drawn with per-column mu/sigma (``normalTable``)."""
+    mu_vec = wrap_array(mu_vec, ndim=1)
+    n_cols = mu_vec.shape[0]
+    sig = wrap_array(sigma_vec, ndim=1) if sigma_vec is not None else jnp.full((n_cols,), sigma)
+    z = jax.random.normal(_key_of(rng), (n_rows, n_cols), dtype=dtype)
+    return mu_vec[None, :] + sig[None, :] * z
+
+
+def fill(rng, shape, value, dtype=jnp.float32):
+    """``rng.cuh`` ``fill`` (kept for API parity; not actually random)."""
+    del rng
+    return jnp.full(shape, value, dtype=dtype)
+
+
+def bernoulli(rng, shape, prob: float):
+    return jax.random.bernoulli(_key_of(rng), prob, shape)
+
+
+def scaled_bernoulli(rng, shape, prob: float, scale: float, dtype=jnp.float32):
+    """±scale with probability flip (``scaledBernoulli``)."""
+    b = jax.random.bernoulli(_key_of(rng), prob, shape)
+    return jnp.where(b, jnp.asarray(scale, dtype), jnp.asarray(-scale, dtype))
+
+
+def gumbel(rng, shape, mu=0.0, beta=1.0, dtype=jnp.float32):
+    return mu + beta * jax.random.gumbel(_key_of(rng), shape, dtype=dtype)
+
+
+def lognormal(rng, shape, mu=0.0, sigma=1.0, dtype=jnp.float32):
+    return jnp.exp(normal(rng, shape, mu, sigma, dtype))
+
+
+def logistic(rng, shape, mu=0.0, scale=1.0, dtype=jnp.float32):
+    return mu + scale * jax.random.logistic(_key_of(rng), shape, dtype=dtype)
+
+
+def exponential(rng, shape, lam=1.0, dtype=jnp.float32):
+    return jax.random.exponential(_key_of(rng), shape, dtype=dtype) / lam
+
+
+def rayleigh(rng, shape, sigma=1.0, dtype=jnp.float32):
+    u = jax.random.uniform(_key_of(rng), shape, dtype=dtype, minval=1e-12, maxval=1.0)
+    return sigma * jnp.sqrt(-2.0 * jnp.log(u))
+
+
+def laplace(rng, shape, mu=0.0, scale=1.0, dtype=jnp.float32):
+    return jax.random.laplace(_key_of(rng), shape, dtype=dtype) * scale + mu
+
+
+def discrete(rng, shape, weights):
+    """Sample indices proportional to weights (``discrete``)."""
+    weights = wrap_array(weights, ndim=1)
+    logits = jnp.log(jnp.maximum(weights, 1e-38))
+    return jax.random.categorical(_key_of(rng), logits, shape=shape)
+
+
+def sample_without_replacement(rng, population: int, n_samples: int, weights=None):
+    """Weighted sampling without replacement (``rng.cuh``
+    ``sample_without_replacement``) via the Gumbel top-k trick — one fused
+    top_k instead of sequential draws."""
+    expects(n_samples <= population, "cannot sample more than population")
+    key = _key_of(rng)
+    g = jax.random.gumbel(key, (population,))
+    if weights is not None:
+        g = g + jnp.log(jnp.maximum(wrap_array(weights, ndim=1), 1e-38))
+    _, idx = jax.lax.top_k(g, n_samples)
+    return idx
+
+
+def excess_subsample(rng, population: int, n_samples: int):
+    """Uniform subsample via excess-draw (``detail/rng_impl.cuh``
+    ``excess_subsample``): functionally identical to unweighted
+    :func:`sample_without_replacement`."""
+    return sample_without_replacement(rng, population, n_samples)

@@ -1,0 +1,17 @@
+"""raft_tpu.stats — summary statistics + clustering/regression/ANN metrics.
+
+TPU-native analog of ``cpp/include/raft/stats`` (SURVEY.md §2.7).
+"""
+
+from .summary import (
+    mean, stddev, sum, meanvar, mean_center, mean_add,
+    minmax, cov, weighted_mean, row_weighted_mean, col_weighted_mean,
+    histogram, dispersion,
+)
+from .metrics import accuracy, r2_score, RegressionMetrics, regression_metrics, contingency_matrix
+from .clustering import (
+    adjusted_rand_index, rand_index, mutual_info_score, entropy,
+    homogeneity_score, completeness_score, v_measure, kl_divergence,
+    silhouette_score, IC_Type, information_criterion_batched,
+)
+from .neighborhood import neighborhood_recall, trustworthiness_score

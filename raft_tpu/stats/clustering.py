@@ -1,0 +1,165 @@
+"""Clustering-quality metrics — parity with ``cpp/include/raft/stats``:
+``adjusted_rand_index.cuh``, ``rand_index.cuh``, ``mutual_info_score.cuh``,
+``entropy.cuh``, ``homogeneity_score.cuh``, ``completeness_score.cuh``,
+``v_measure.cuh``, ``kl_divergence.cuh``, ``silhouette_score.cuh``
+(+ ``detail/batched``), ``information_criterion.cuh``.
+
+All are formulated over the contingency matrix (one scatter-add) + reductions.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.array import wrap_array
+from .metrics import contingency_matrix
+
+__all__ = [
+    "adjusted_rand_index", "rand_index", "mutual_info_score", "entropy",
+    "homogeneity_score", "completeness_score", "v_measure", "kl_divergence",
+    "silhouette_score", "IC_Type", "information_criterion_batched",
+]
+
+
+def _comb2(x):
+    return x * (x - 1) / 2.0
+
+
+def adjusted_rand_index(first, second, n_classes: Optional[int] = None):
+    """ARI (``adjusted_rand_index.cuh``)."""
+    c = contingency_matrix(first, second, n_classes).astype(jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
+    n = jnp.sum(c)
+    sum_comb_cells = jnp.sum(_comb2(c))
+    a = jnp.sum(c, axis=1)
+    b = jnp.sum(c, axis=0)
+    sum_comb_a = jnp.sum(_comb2(a))
+    sum_comb_b = jnp.sum(_comb2(b))
+    expected = sum_comb_a * sum_comb_b / _comb2(n)
+    max_index = 0.5 * (sum_comb_a + sum_comb_b)
+    return (sum_comb_cells - expected) / (max_index - expected)
+
+
+def rand_index(first, second):
+    """Unadjusted Rand index (``rand_index.cuh``)."""
+    a = wrap_array(first, ndim=1)
+    b = wrap_array(second, ndim=1)
+    same_a = a[:, None] == a[None, :]
+    same_b = b[:, None] == b[None, :]
+    n = a.shape[0]
+    agree = jnp.sum((same_a == same_b).astype(jnp.float32)) - n  # drop diagonal
+    return agree / (n * (n - 1))
+
+
+def entropy(labels, n_classes: Optional[int] = None):
+    """Shannon entropy of a label set, in nats (``entropy.cuh``)."""
+    y = wrap_array(labels, ndim=1).astype(jnp.int32)
+    if n_classes is None:
+        n_classes = int(jnp.max(y)) + 1
+    counts = jnp.zeros((n_classes,), jnp.float32).at[y].add(1.0)
+    p = counts / y.shape[0]
+    return -jnp.sum(jnp.where(p > 0, p * jnp.log(p), 0.0))
+
+
+def mutual_info_score(first, second, n_classes: Optional[int] = None):
+    """MI over the contingency matrix (``mutual_info_score.cuh``)."""
+    c = contingency_matrix(first, second, n_classes).astype(jnp.float32)
+    n = jnp.sum(c)
+    pij = c / n
+    pi = jnp.sum(pij, axis=1, keepdims=True)
+    pj = jnp.sum(pij, axis=0, keepdims=True)
+    ratio = pij / jnp.where(pi * pj > 0, pi * pj, 1.0)
+    return jnp.sum(jnp.where(pij > 0, pij * jnp.log(jnp.where(ratio > 0, ratio, 1.0)), 0.0))
+
+
+def homogeneity_score(truth, predicted, n_classes: Optional[int] = None):
+    """(``homogeneity_score.cuh``): 1 − H(C|K)/H(C) via MI/entropy."""
+    mi = mutual_info_score(truth, predicted, n_classes)
+    h = entropy(truth, n_classes)
+    return jnp.where(h > 0, mi / h, 1.0)
+
+
+def completeness_score(truth, predicted, n_classes: Optional[int] = None):
+    """(``completeness_score.cuh``)."""
+    mi = mutual_info_score(truth, predicted, n_classes)
+    h = entropy(predicted, n_classes)
+    return jnp.where(h > 0, mi / h, 1.0)
+
+
+def v_measure(truth, predicted, n_classes: Optional[int] = None, beta: float = 1.0):
+    """(``v_measure.cuh``)."""
+    h = homogeneity_score(truth, predicted, n_classes)
+    c = completeness_score(truth, predicted, n_classes)
+    denom = beta * h + c
+    return jnp.where(denom > 0, (1 + beta) * h * c / denom, 0.0)
+
+
+def kl_divergence(p, q):
+    """KL(P‖Q) over densities (``kl_divergence.cuh``)."""
+    p = wrap_array(p)
+    q = wrap_array(q)
+    return jnp.sum(jnp.where(p > 0, p * jnp.log(p / jnp.where(q > 0, q, 1.0)), 0.0))
+
+
+def silhouette_score(x, labels, n_clusters: Optional[int] = None, batch_size: Optional[int] = None):
+    """Mean silhouette coefficient (``silhouette_score.cuh`` + batched variant).
+
+    Per-sample mean distance to each cluster via one pairwise-distance matmul
+    block + segment reduction; ``batch_size`` bounds the distance tile exactly
+    like ``detail/batched/silhouette_score.cuh``.
+    """
+    x = wrap_array(x, ndim=2)
+    y = wrap_array(labels, ndim=1).astype(jnp.int32)
+    n = x.shape[0]
+    if n_clusters is None:
+        n_clusters = int(jnp.max(y)) + 1
+    counts = jnp.zeros((n_clusters,), jnp.float32).at[y].add(1.0)
+    onehot = jax.nn.one_hot(y, n_clusters, dtype=jnp.float32)  # (n, k)
+
+    def tile_stats(xb):
+        # Euclidean distances from tile rows to all points → (b, n)
+        sq = jnp.sum(xb * xb, axis=1, keepdims=True) + jnp.sum(x * x, axis=1)[None, :] \
+             - 2.0 * jnp.matmul(xb, x.T, preferred_element_type=jnp.float32)
+        d = jnp.sqrt(jnp.maximum(sq, 0.0))
+        # sum of distances to each cluster: (b, k)
+        return jnp.matmul(d, onehot, preferred_element_type=jnp.float32)
+
+    if batch_size is None or batch_size >= n:
+        cluster_dist = tile_stats(x)
+    else:
+        pad = (-n) % batch_size
+        xp = jnp.concatenate([x, jnp.zeros((pad, x.shape[1]), x.dtype)])
+        tiles = xp.reshape(-1, batch_size, x.shape[1])
+        cluster_dist = jax.lax.map(tile_stats, tiles).reshape(-1, n_clusters)[:n]
+
+    own = counts[y]
+    own_dist = jnp.take_along_axis(cluster_dist, y[:, None], axis=1)[:, 0]
+    a = jnp.where(own > 1, own_dist / jnp.maximum(own - 1, 1.0), 0.0)
+    mean_other = cluster_dist / jnp.maximum(counts[None, :], 1.0)
+    mean_other = jnp.where(jax.nn.one_hot(y, n_clusters, dtype=bool), jnp.inf, mean_other)
+    b = jnp.min(mean_other, axis=1)
+    s = jnp.where(own > 1, (b - a) / jnp.maximum(jnp.maximum(a, b), 1e-12), 0.0)
+    return jnp.mean(s)
+
+
+class IC_Type(enum.Enum):
+    """``information_criterion.cuh`` (AIC / AICc / BIC)."""
+
+    AIC = "aic"
+    AICc = "aicc"
+    BIC = "bic"
+
+
+def information_criterion_batched(log_likelihood, ic_type: IC_Type, n_params: int, n_samples: int):
+    """Batched information criterion (``information_criterion.cuh``)."""
+    ll = wrap_array(log_likelihood)
+    if ic_type == IC_Type.AIC:
+        penalty = 2.0 * n_params
+    elif ic_type == IC_Type.AICc:
+        penalty = 2.0 * n_params + 2.0 * n_params * (n_params + 1) / max(n_samples - n_params - 1, 1)
+    else:
+        penalty = jnp.log(jnp.asarray(float(n_samples))) * n_params
+    return -2.0 * ll + penalty

@@ -1,0 +1,65 @@
+"""Regression / classification metrics — parity with ``cpp/include/raft/stats``:
+``accuracy.cuh``, ``r2_score.cuh``, ``regression_metrics.cuh``,
+``contingency_matrix.cuh``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.array import wrap_array
+from ..core.errors import expects
+
+__all__ = ["accuracy", "r2_score", "RegressionMetrics", "regression_metrics", "contingency_matrix"]
+
+
+def accuracy(predictions, ref_predictions):
+    """Fraction of matching labels (``accuracy.cuh``)."""
+    p = wrap_array(predictions, ndim=1)
+    r = wrap_array(ref_predictions, ndim=1)
+    expects(p.shape == r.shape, "prediction length mismatch")
+    return jnp.mean((p == r).astype(jnp.float32))
+
+
+def r2_score(y, y_hat):
+    """Coefficient of determination (``r2_score.cuh``)."""
+    y = wrap_array(y, ndim=1)
+    y_hat = wrap_array(y_hat, ndim=1)
+    mu = jnp.mean(y)
+    ss_tot = jnp.sum((y - mu) ** 2)
+    ss_res = jnp.sum((y - y_hat) ** 2)
+    return 1.0 - ss_res / ss_tot
+
+
+class RegressionMetrics(NamedTuple):
+    mean_abs_error: jax.Array
+    mean_squared_error: jax.Array
+    median_abs_error: jax.Array
+
+
+def regression_metrics(predictions, ref_predictions) -> RegressionMetrics:
+    """MAE / MSE / median-AE (``regression_metrics.cuh``)."""
+    p = wrap_array(predictions, ndim=1)
+    r = wrap_array(ref_predictions, ndim=1)
+    err = jnp.abs(p - r)
+    return RegressionMetrics(
+        mean_abs_error=jnp.mean(err),
+        mean_squared_error=jnp.mean((p - r) ** 2),
+        median_abs_error=jnp.median(err),
+    )
+
+
+def contingency_matrix(ground_truth, predicted, n_classes: Optional[int] = None):
+    """Label contingency matrix (``contingency_matrix.cuh``).  Segment-sum of
+    one-hot outer products → a single scatter-add."""
+    gt = wrap_array(ground_truth, ndim=1).astype(jnp.int32)
+    pr = wrap_array(predicted, ndim=1).astype(jnp.int32)
+    expects(gt.shape == pr.shape, "label length mismatch")
+    if n_classes is None:
+        n_classes = int(jnp.maximum(jnp.max(gt), jnp.max(pr))) + 1
+    flat = gt * n_classes + pr
+    counts = jnp.zeros((n_classes * n_classes,), jnp.int32).at[flat].add(1)
+    return counts.reshape(n_classes, n_classes)

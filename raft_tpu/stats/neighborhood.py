@@ -1,0 +1,94 @@
+"""Embedding / ANN quality metrics — parity with
+``cpp/include/raft/stats/trustworthiness_score.cuh`` and
+``stats/neighborhood_recall.cuh:77`` (the metric behind the north-star
+QPS@recall target).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.array import wrap_array
+from ..core.errors import expects
+
+__all__ = ["neighborhood_recall", "trustworthiness_score"]
+
+
+def neighborhood_recall(indices, ref_indices, distances=None, ref_distances=None, eps: float = 1e-6):
+    """Recall@k of ANN results against ground truth
+    (``neighborhood_recall.cuh:77``).
+
+    Counts, per query, how many returned ids appear in the reference top-k;
+    like the reference, an id mismatch still counts when the *distances* match
+    within ``eps`` (duplicate-distance ties).
+    """
+    idx = wrap_array(indices, ndim=2)
+    ref = wrap_array(ref_indices, ndim=2)
+    expects(idx.shape == ref.shape, "indices/ref_indices shape mismatch")
+    match = (idx[:, :, None] == ref[:, None, :]).any(axis=2)
+    if distances is not None and ref_distances is not None:
+        d = wrap_array(distances, ndim=2)
+        rd = wrap_array(ref_distances, ndim=2)
+        tie = (jnp.abs(d[:, :, None] - rd[:, None, :]) <= eps).any(axis=2)
+        match = match | tie
+    return jnp.mean(match.astype(jnp.float32))
+
+
+def trustworthiness_score(x, x_embedded, n_neighbors: int, batch_size: int = 512):
+    """Trustworthiness of an embedding (``trustworthiness_score.cuh``).
+
+    T = 1 − 2/(n·k·(2n−3k−1)) · Σ_i Σ_{j∈U_i^k} (r(i,j) − k) where r(i,j) is
+    the rank of j among i's original-space neighbors and U_i^k the embedded
+    k-NN not among the original k-NN.
+
+    Tiled over query batches of ``batch_size`` (like the reference's batched
+    pairwise-distance driver): peak memory is O(batch_size · n), never n².
+    Ranks are computed by *counting* points closer than each selected
+    neighbor — no n×n argsort materialization.
+    """
+    x = wrap_array(x, ndim=2)
+    e = wrap_array(x_embedded, ndim=2)
+    n, k = x.shape[0], n_neighbors
+    expects(n == e.shape[0], "row count mismatch")
+
+    x_sq = jnp.sum(x * x, axis=1)
+    e_sq = jnp.sum(e * e, axis=1)
+
+    batch_size = min(batch_size, n)
+    n_tiles = (n + batch_size - 1) // batch_size
+    pad = n_tiles * batch_size - n
+    # pad the *query* side only; the database stays exactly n points and
+    # padded query rows are masked out by `valid`
+    x_pad = jnp.concatenate([x, jnp.zeros((pad, x.shape[1]), x.dtype)]) if pad else x
+    e_pad = jnp.concatenate([e, jnp.zeros((pad, e.shape[1]), e.dtype)]) if pad else e
+
+    def tile_penalty(start):
+        rows_x = jax.lax.dynamic_slice_in_dim(x_pad, start, batch_size, 0)
+        rows_e = jax.lax.dynamic_slice_in_dim(e_pad, start, batch_size, 0)
+        row_ids = start + jnp.arange(batch_size)
+        valid = (row_ids < n)[:, None]
+        self_mask = row_ids[:, None] == jnp.arange(n)[None, :]
+
+        d_o = jnp.maximum(
+            jnp.sum(rows_x * rows_x, 1)[:, None] + x_sq[None, :]
+            - 2.0 * jnp.matmul(rows_x, x.T, preferred_element_type=jnp.float32), 0.0)
+        d_e = jnp.maximum(
+            jnp.sum(rows_e * rows_e, 1)[:, None] + e_sq[None, :]
+            - 2.0 * jnp.matmul(rows_e, e.T, preferred_element_type=jnp.float32), 0.0)
+        d_o = jnp.where(self_mask, jnp.inf, d_o)
+        d_e = jnp.where(self_mask, jnp.inf, d_e)
+
+        _, emb_nn = jax.lax.top_k(-d_e, k)                      # (b, k)
+        d_sel = jnp.take_along_axis(d_o, emb_nn, axis=1)        # (b, k)
+        # rank(i, j) = #points strictly closer to i than j in original space
+        r = jnp.sum((d_o[:, None, :] < d_sel[:, :, None]) & jnp.isfinite(d_o)[:, None, :],
+                    axis=2).astype(jnp.float32)
+        pen = jnp.maximum(r - (k - 1), 0.0) * (r >= k)
+        return jnp.sum(jnp.where(valid, pen, 0.0))
+
+    starts = jnp.arange(n_tiles) * batch_size
+    penalty = jnp.sum(jax.lax.map(tile_penalty, starts))
+    return 1.0 - 2.0 / (n * k * (2.0 * n - 3.0 * k - 1.0)) * penalty

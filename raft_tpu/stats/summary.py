@@ -1,0 +1,132 @@
+"""Summary statistics — parity with ``cpp/include/raft/stats``: ``mean.cuh:37``,
+``stddev.cuh``, ``sum.cuh``, ``meanvar.cuh``, ``mean_center.cuh``,
+``minmax.cuh``, ``cov.cuh``, ``weighted_mean.cuh``, ``histogram.cuh``
+(multi-strategy kernel ``detail/histogram.cuh``), ``dispersion.cuh``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.array import wrap_array
+from ..core.errors import expects
+
+__all__ = [
+    "mean", "stddev", "sum", "meanvar", "mean_center", "mean_add",
+    "minmax", "cov", "weighted_mean", "row_weighted_mean", "col_weighted_mean",
+    "histogram", "dispersion",
+]
+
+
+def mean(data, sample: bool = False, along_rows: bool = True):
+    """Column means of a row-major matrix (``stats::mean``, ``mean.cuh:37``).
+
+    ``sample`` selects the (n−1) divisor like the reference.
+    """
+    data = wrap_array(data, ndim=2)
+    axis = 0 if along_rows else 1
+    n = data.shape[axis]
+    s = jnp.sum(data, axis=axis)
+    return s / (n - 1 if sample else n)
+
+
+def stddev(data, mu=None, sample: bool = True):
+    """Column standard deviations (``stddev.cuh``)."""
+    data = wrap_array(data, ndim=2)
+    if mu is None:
+        mu = jnp.mean(data, axis=0)
+    n = data.shape[0]
+    var = jnp.sum((data - mu[None, :]) ** 2, axis=0) / (n - 1 if sample else n)
+    return jnp.sqrt(var)
+
+
+def sum(data, along_rows: bool = True):
+    """Column (or row) sums (``sum.cuh``)."""
+    return jnp.sum(wrap_array(data, ndim=2), axis=0 if along_rows else 1)
+
+
+def meanvar(data, sample: bool = True) -> Tuple[jax.Array, jax.Array]:
+    """Fused mean+variance (``meanvar.cuh``)."""
+    data = wrap_array(data, ndim=2)
+    n = data.shape[0]
+    mu = jnp.mean(data, axis=0)
+    var = jnp.sum((data - mu[None, :]) ** 2, axis=0) / (n - 1 if sample else n)
+    return mu, var
+
+
+def mean_center(data, mu=None):
+    """Subtract column means (``mean_center.cuh``)."""
+    data = wrap_array(data, ndim=2)
+    if mu is None:
+        mu = jnp.mean(data, axis=0)
+    return data - wrap_array(mu, ndim=1)[None, :]
+
+
+def mean_add(data, mu):
+    """Add column means back (``mean_center.cuh`` ``meanAdd``)."""
+    return wrap_array(data, ndim=2) + wrap_array(mu, ndim=1)[None, :]
+
+
+def minmax(data) -> Tuple[jax.Array, jax.Array]:
+    """Per-column (min, max) (``minmax.cuh``)."""
+    data = wrap_array(data, ndim=2)
+    return jnp.min(data, axis=0), jnp.max(data, axis=0)
+
+
+def cov(data, mu=None, sample: bool = True, stable: bool = True):
+    """Covariance matrix (``cov.cuh``).  One MXU gram matmul."""
+    data = wrap_array(data, ndim=2)
+    if mu is None:
+        mu = jnp.mean(data, axis=0)
+    centered = data - wrap_array(mu, ndim=1)[None, :]
+    n = data.shape[0]
+    return jnp.matmul(centered.T, centered, preferred_element_type=jnp.float32) / (
+        n - 1 if sample else n
+    )
+
+
+def weighted_mean(data, weights, along_rows: bool = True):
+    """Weighted mean (``weighted_mean.cuh``)."""
+    data = wrap_array(data, ndim=2)
+    weights = wrap_array(weights, ndim=1)
+    if along_rows:  # weight per row, average over rows → per-column result
+        expects(weights.shape[0] == data.shape[0], "need one weight per row")
+        return jnp.sum(data * weights[:, None], axis=0) / jnp.sum(weights)
+    expects(weights.shape[0] == data.shape[1], "need one weight per column")
+    return jnp.sum(data * weights[None, :], axis=1) / jnp.sum(weights)
+
+
+def row_weighted_mean(data, weights):
+    return weighted_mean(data, weights, along_rows=False)
+
+
+def col_weighted_mean(data, weights):
+    return weighted_mean(data, weights, along_rows=True)
+
+
+def histogram(data, n_bins: int, lower: float = None, upper: float = None):
+    """Per-column histograms (``histogram.cuh``).  The reference picks among
+    smem/gmem atomic strategies; XLA lowers the one-hot sum onto the VPU."""
+    data = wrap_array(data)
+    if data.ndim == 1:
+        data = data[:, None]
+    lo = jnp.min(data) if lower is None else lower
+    hi = jnp.max(data) if upper is None else upper
+    width = jnp.where((hi - lo) > 0, (hi - lo) / n_bins, 1.0)
+    bins = jnp.clip(((data - lo) / width).astype(jnp.int32), 0, n_bins - 1)
+    onehot = jax.nn.one_hot(bins, n_bins, dtype=jnp.int32, axis=0)  # (n_bins, n, cols)
+    return jnp.sum(onehot, axis=1)
+
+
+def dispersion(centroids, cluster_sizes, global_centroid=None, n_points: int = None):
+    """Between-cluster dispersion (``dispersion.cuh``)."""
+    centroids = wrap_array(centroids, ndim=2)
+    sizes = wrap_array(cluster_sizes, ndim=1)
+    n = jnp.sum(sizes) if n_points is None else n_points
+    if global_centroid is None:
+        global_centroid = jnp.sum(centroids * sizes[:, None], axis=0) / n
+    d2 = jnp.sum((centroids - global_centroid[None, :]) ** 2, axis=1)
+    return jnp.sqrt(jnp.sum(d2 * sizes))

@@ -1,0 +1,219 @@
+"""linalg tests — parity with ``cpp/tests/linalg/`` (42 suites): each primitive
+validated against a naive numpy reference with tolerance (devArrMatch style)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import linalg
+from raft_tpu.linalg import Apply, NormType
+
+
+def assert_close(a, b, rtol=1e-4, atol=1e-5):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=rtol, atol=atol)
+
+
+class TestElementwise:
+    def test_binary_family(self, rng):
+        x = rng.random((8, 5)).astype(np.float32)
+        y = rng.random((8, 5)).astype(np.float32) + 0.5
+        assert_close(linalg.add(x, y), x + y)
+        assert_close(linalg.subtract(x, y), x - y)
+        assert_close(linalg.multiply(x, y), x * y)
+        assert_close(linalg.divide(x, y), x / y)
+        assert_close(linalg.power(np.abs(x), y), np.abs(x) ** y)
+        assert_close(linalg.sqrt(np.abs(x)), np.sqrt(np.abs(x)))
+        assert_close(linalg.add_scalar(x, 2.0), x + 2.0)
+
+    def test_map_and_offset(self, rng):
+        x = rng.random((4, 4)).astype(np.float32)
+        out = linalg.map(lambda a, b: a * 2 + b, x, x)
+        assert_close(out, 3 * x)
+        off = linalg.map_offset(lambda i: i * 2, (3, 3))
+        assert_close(off, (np.arange(9) * 2).reshape(3, 3))
+
+    def test_shape_mismatch_raises(self, rng):
+        with pytest.raises(Exception):
+            linalg.add(np.zeros((2, 3)), np.zeros((3, 2)))
+
+
+class TestReduce:
+    def test_reduce_directions(self, rng):
+        x = rng.random((6, 4)).astype(np.float32)
+        assert_close(linalg.reduce(x, apply=Apply.ALONG_ROWS), x.sum(axis=1))
+        assert_close(linalg.reduce(x, apply=Apply.ALONG_COLUMNS), x.sum(axis=0))
+
+    def test_reduce_ops(self, rng):
+        x = rng.random((6, 4)).astype(np.float32)
+        # sum of squares with sqrt epilogue = L2 row norm
+        out = linalg.reduce(x, main_op=lambda v: v * v, final_op=jnp.sqrt)
+        assert_close(out, np.linalg.norm(x, axis=1), rtol=1e-4)
+        out = linalg.reduce(x, reduce_op=jnp.minimum, init=np.inf)
+        assert_close(out, x.min(axis=1))
+
+    def test_map_reduce(self, rng):
+        x = rng.random(64).astype(np.float32)
+        assert_close(linalg.map_reduce(lambda v: v * v, jnp.add, x), (x * x).sum(), rtol=1e-4)
+
+    def test_reduce_rows_by_key(self, rng):
+        x = rng.random((10, 3)).astype(np.float32)
+        keys = np.array([0, 1, 0, 2, 1, 0, 2, 2, 1, 0])
+        out = linalg.reduce_rows_by_key(x, keys, 3)
+        expected = np.stack([x[keys == k].sum(axis=0) for k in range(3)])
+        assert_close(out, expected, rtol=1e-4)
+
+    def test_reduce_cols_by_key(self, rng):
+        x = rng.random((3, 6)).astype(np.float32)
+        keys = np.array([0, 1, 1, 0, 2, 2])
+        out = linalg.reduce_cols_by_key(x, keys, 3)
+        expected = np.stack([x[:, keys == k].sum(axis=1) for k in range(3)], axis=1)
+        assert_close(out, expected, rtol=1e-4)
+
+    def test_mse(self, rng):
+        a, b = rng.random(32).astype(np.float32), rng.random(32).astype(np.float32)
+        assert_close(linalg.mean_squared_error(a, b), np.mean((a - b) ** 2), rtol=1e-5)
+
+
+class TestNorm:
+    def test_row_norms(self, rng):
+        x = (rng.random((5, 7)).astype(np.float32) - 0.5) * 4
+        assert_close(linalg.row_norm(x, NormType.L1Norm), np.abs(x).sum(axis=1), rtol=1e-4)
+        # reference L2 norm is sum-of-squares unless rooted
+        assert_close(linalg.row_norm(x, NormType.L2Norm), (x * x).sum(axis=1), rtol=1e-4)
+        assert_close(linalg.row_norm(x, NormType.L2Norm, root=True), np.linalg.norm(x, axis=1), rtol=1e-4)
+        assert_close(linalg.col_norm(x, NormType.LinfNorm), np.abs(x).max(axis=0))
+
+    def test_normalize(self, rng):
+        x = rng.random((5, 7)).astype(np.float32) + 0.1
+        out = np.asarray(linalg.normalize(x))
+        assert_close(np.linalg.norm(out, axis=1), np.ones(5), rtol=1e-4)
+
+    def test_normalize_zero_row_stays(self):
+        x = np.zeros((2, 3), np.float32)
+        out = linalg.normalize(x)
+        assert_close(out, x)
+
+    def test_matrix_vector_op(self, rng):
+        m = rng.random((4, 6)).astype(np.float32)
+        v = rng.random(6).astype(np.float32)
+        assert_close(linalg.matrix_vector_op(m, v, jnp.add), m + v[None, :])
+        v2 = rng.random(4).astype(np.float32)
+        assert_close(linalg.matrix_vector_op(m, v2, jnp.multiply, along_rows=False), m * v2[:, None])
+
+    def test_binary_div_skip_zero(self, rng):
+        m = rng.random((3, 4)).astype(np.float32)
+        v = np.array([2.0, 0.0, 4.0, 0.0], np.float32)
+        out = np.asarray(linalg.binary_div_skip_zero(m, v, return_zero=True))
+        assert_close(out[:, 0], m[:, 0] / 2.0)
+        assert_close(out[:, 1], np.zeros(3))
+
+
+class TestBlas:
+    def test_gemm(self, rng):
+        a = rng.random((5, 3)).astype(np.float32)
+        b = rng.random((3, 4)).astype(np.float32)
+        assert_close(linalg.gemm(a, b), a @ b, rtol=1e-4)
+        assert_close(linalg.gemm(a.T, b, trans_a=True), a @ b, rtol=1e-4)
+        c = rng.random((5, 4)).astype(np.float32)
+        assert_close(linalg.gemm(a, b, alpha=2.0, beta=0.5, c=c), 2 * a @ b + 0.5 * c, rtol=1e-4)
+
+    def test_gemv_dot_axpy(self, rng):
+        a = rng.random((5, 3)).astype(np.float32)
+        x = rng.random(3).astype(np.float32)
+        assert_close(linalg.gemv(a, x), a @ x, rtol=1e-4)
+        y = rng.random(5).astype(np.float32)
+        assert_close(linalg.axpy(2.0, y, y), 3 * y, rtol=1e-5)
+        assert_close(linalg.dot(x, x), x @ x, rtol=1e-5)
+
+    def test_bf16_gemm_accumulates_f32(self, rng):
+        a = jnp.asarray(rng.random((64, 64)), jnp.bfloat16)
+        out = linalg.gemm(a, a)
+        assert out.dtype == jnp.float32
+
+
+class TestDecomp:
+    def test_eig_dc(self, rng):
+        a = rng.random((8, 8)).astype(np.float32)
+        sym = (a + a.T) / 2
+        vals, vecs = linalg.eig_dc(sym)
+        recon = np.asarray(vecs) @ np.diag(np.asarray(vals)) @ np.asarray(vecs).T
+        assert_close(recon, sym, rtol=1e-3, atol=1e-4)
+
+    def test_eig_jacobi_matches_eigh(self, rng):
+        a = rng.random((6, 6)).astype(np.float32)
+        sym = (a + a.T) / 2
+        vals_j, vecs_j = linalg.eig_jacobi(sym)
+        vals_ref = np.linalg.eigvalsh(sym)
+        assert_close(vals_j, vals_ref, rtol=1e-3, atol=1e-4)
+        recon = np.asarray(vecs_j) @ np.diag(np.asarray(vals_j)) @ np.asarray(vecs_j).T
+        assert_close(recon, sym, rtol=1e-3, atol=1e-3)
+
+    def test_eig_selective(self, rng):
+        a = rng.random((8, 8)).astype(np.float32)
+        sym = (a + a.T) / 2
+        vals, vecs = linalg.eig_dc_selective(sym, 3, "largest")
+        assert vals.shape == (3,) and vecs.shape == (8, 3)
+        assert_close(vals, np.linalg.eigvalsh(sym)[-3:], rtol=1e-3, atol=1e-4)
+
+    def test_qr(self, rng):
+        a = rng.random((10, 4)).astype(np.float32)
+        q, r = linalg.qr_get_qr(a)
+        assert_close(np.asarray(q) @ np.asarray(r), a, rtol=1e-3, atol=1e-4)
+        assert_close(np.asarray(q).T @ np.asarray(q), np.eye(4), atol=1e-4)
+
+    def test_svd_qr_and_eig(self, rng):
+        a = rng.random((12, 5)).astype(np.float32)
+        for fn in (linalg.svd_qr, linalg.svd_eig, linalg.svd_jacobi):
+            u, s, v = fn(a)
+            recon = np.asarray(u) @ np.diag(np.asarray(s)) @ np.asarray(v).T
+            assert_close(recon, a, rtol=1e-2, atol=1e-3)
+            assert_close(np.sort(np.asarray(s)), np.sort(np.linalg.svd(a)[1]), rtol=1e-3, atol=1e-3)
+
+    def test_rsvd(self, rng):
+        # low-rank + noise: rsvd should recover the dominant singular values
+        u0 = rng.standard_normal((100, 5)).astype(np.float32)
+        v0 = rng.standard_normal((5, 40)).astype(np.float32)
+        a = u0 @ v0
+        u, s, v = linalg.rsvd_fixed_rank(a, k=5, key=jax.random.PRNGKey(1))
+        s_ref = np.linalg.svd(a)[1][:5]
+        assert_close(s, s_ref, rtol=1e-2)
+
+    def test_lstsq_all_paths(self, rng):
+        a = rng.standard_normal((30, 4)).astype(np.float32)
+        x_true = rng.standard_normal(4).astype(np.float32)
+        b = a @ x_true
+        for fn in (linalg.lstsq_svd_qr, linalg.lstsq_eig, linalg.lstsq_qr):
+            assert_close(fn(a, b), x_true, rtol=1e-2, atol=1e-3)
+
+    def test_cholesky_r1_update(self, rng):
+        a = rng.standard_normal((5, 5)).astype(np.float32)
+        spd = a @ a.T + 5 * np.eye(5, dtype=np.float32)
+        L_small = np.linalg.cholesky(spd[:4, :4])
+        new_col = np.concatenate([spd[4, :4], [spd[4, 4]]]).astype(np.float32)
+        L_full = linalg.cholesky_r1_update(L_small, new_col)
+        assert_close(np.asarray(L_full), np.linalg.cholesky(spd), rtol=1e-3, atol=1e-4)
+
+
+class TestPca:
+    def test_fit_transform_roundtrip(self, rng):
+        x = rng.standard_normal((200, 10)).astype(np.float32)
+        x[:, 0] *= 10  # dominant direction
+        params = linalg.PcaParams(n_components=3)
+        proj, model = linalg.pca_fit_transform(x, params)
+        assert proj.shape == (200, 3)
+        # components orthonormal
+        c = np.asarray(model.components)
+        assert_close(c @ c.T, np.eye(3), atol=1e-4)
+        # variance ordering
+        ev = np.asarray(model.explained_variance)
+        assert (np.diff(ev) <= 1e-3).all()
+        # reconstruct ≈ best rank-3 approx
+        recon = linalg.pca_inverse_transform(proj, model, params)
+        assert np.mean((np.asarray(recon) - x) ** 2) < np.var(x)
+
+    def test_jacobi_solver_agrees(self, rng):
+        x = rng.standard_normal((100, 6)).astype(np.float32)
+        ev_dq = linalg.pca_fit(x, linalg.PcaParams(3, linalg.PcaSolver.COV_EIG_DQ)).explained_variance
+        ev_j = linalg.pca_fit(x, linalg.PcaParams(3, linalg.PcaSolver.COV_EIG_JACOBI)).explained_variance
+        assert_close(ev_dq, ev_j, rtol=1e-3, atol=1e-4)

@@ -1,0 +1,150 @@
+"""random tests — parity with ``cpp/tests/random/`` (11 suites) and
+``pylibraft/tests/test_random.py``: distribution moments, sampling invariants,
+blob separability, rmat bounds/skew."""
+
+import jax
+import numpy as np
+import pytest
+
+from raft_tpu import random as rnd
+from raft_tpu.random import RngState
+
+
+class TestDistributions:
+    def setup_method(self):
+        self.state = RngState(seed=42)
+
+    def test_uniform_bounds_and_mean(self):
+        x = np.asarray(rnd.uniform(self.state, (20000,), -2.0, 3.0))
+        assert x.min() >= -2.0 and x.max() < 3.0
+        assert abs(x.mean() - 0.5) < 0.05
+
+    def test_normal_moments(self):
+        x = np.asarray(rnd.normal(self.state, (20000,), mu=1.5, sigma=2.0))
+        assert abs(x.mean() - 1.5) < 0.06
+        assert abs(x.std() - 2.0) < 0.06
+
+    def test_uniform_int(self):
+        x = np.asarray(rnd.uniform_int(self.state, (5000,), 3, 10))
+        assert x.min() >= 3 and x.max() < 10
+        assert set(np.unique(x)) == set(range(3, 10))
+
+    def test_bernoulli(self):
+        x = np.asarray(rnd.bernoulli(self.state, (20000,), 0.3))
+        assert abs(x.mean() - 0.3) < 0.02
+
+    def test_scaled_bernoulli(self):
+        x = np.asarray(rnd.scaled_bernoulli(self.state, (10000,), 0.5, 2.5))
+        assert set(np.unique(np.abs(x))) == {2.5}
+
+    def test_lognormal(self):
+        x = np.asarray(rnd.lognormal(self.state, (20000,), mu=0.0, sigma=0.5))
+        assert (x > 0).all()
+        assert abs(np.log(x).mean()) < 0.05
+
+    def test_exponential_rayleigh_laplace_logistic_gumbel(self):
+        n = 20000
+        assert abs(np.asarray(rnd.exponential(self.state, (n,), lam=2.0)).mean() - 0.5) < 0.03
+        sigma = 1.5
+        assert abs(np.asarray(rnd.rayleigh(self.state, (n,), sigma)).mean() - sigma * np.sqrt(np.pi / 2)) < 0.05
+        assert abs(np.asarray(rnd.laplace(self.state, (n,), mu=1.0)).mean() - 1.0) < 0.06
+        assert abs(np.asarray(rnd.logistic(self.state, (n,), mu=-1.0)).mean() + 1.0) < 0.08
+        g = np.asarray(rnd.gumbel(self.state, (n,)))
+        assert abs(g.mean() - 0.5772) < 0.05
+
+    def test_normal_table(self):
+        mu = np.array([0.0, 10.0, -5.0], np.float32)
+        sig = np.array([1.0, 0.1, 2.0], np.float32)
+        x = np.asarray(rnd.normal_table(self.state, 5000, mu, sig))
+        np.testing.assert_allclose(x.mean(axis=0), mu, atol=0.15)
+        np.testing.assert_allclose(x.std(axis=0), sig, rtol=0.1)
+
+    def test_discrete(self):
+        w = np.array([0.1, 0.0, 0.6, 0.3], np.float32)
+        x = np.asarray(rnd.discrete(self.state, (20000,), w))
+        counts = np.bincount(x, minlength=4) / 20000
+        np.testing.assert_allclose(counts, w / w.sum(), atol=0.02)
+        assert counts[1] == 0
+
+    def test_stream_independence(self):
+        a = np.asarray(rnd.normal(self.state, (100,)))
+        b = np.asarray(rnd.normal(self.state, (100,)))
+        assert not np.allclose(a, b)
+
+    def test_determinism_same_seed(self):
+        a = np.asarray(rnd.normal(RngState(7), (50,)))
+        b = np.asarray(rnd.normal(RngState(7), (50,)))
+        np.testing.assert_array_equal(a, b)
+
+
+class TestSampling:
+    def test_sample_without_replacement_unique(self):
+        idx = np.asarray(rnd.sample_without_replacement(RngState(0), 100, 50))
+        assert len(np.unique(idx)) == 50
+        assert idx.min() >= 0 and idx.max() < 100
+
+    def test_weighted_sampling_respects_weights(self):
+        w = np.zeros(100, np.float32)
+        w[:10] = 1.0  # only first 10 have mass
+        idx = np.asarray(rnd.sample_without_replacement(RngState(1), 100, 10, weights=w))
+        assert set(idx.tolist()) == set(range(10))
+
+    def test_permute(self):
+        x = np.arange(40, dtype=np.float32).reshape(20, 2)
+        out, perm = rnd.permute(RngState(3), x)
+        np.testing.assert_allclose(np.sort(np.asarray(out), axis=0), x)
+        assert not np.array_equal(np.asarray(out), x)
+
+
+class TestDatagen:
+    def test_make_blobs_separable(self):
+        x, y = rnd.make_blobs(RngState(5), 500, 8, n_clusters=3, cluster_std=0.1)
+        x, y = np.asarray(x), np.asarray(y)
+        assert x.shape == (500, 8) and set(np.unique(y)) <= {0, 1, 2}
+        # within-cluster scatter far below between-cluster distances
+        centers = np.stack([x[y == c].mean(axis=0) for c in np.unique(y)])
+        d = np.linalg.norm(centers[:, None] - centers[None, :], axis=2)
+        within = max(x[y == c].std(axis=0).max() for c in np.unique(y))
+        assert d[d > 0].min() > 10 * within
+
+    def test_make_blobs_given_centers(self):
+        centers = np.array([[0.0, 0.0], [100.0, 100.0]], np.float32)
+        x, y = rnd.make_blobs(RngState(6), 200, 2, centers=centers, cluster_std=0.5)
+        x, y = np.asarray(x), np.asarray(y)
+        for c in (0, 1):
+            np.testing.assert_allclose(x[y == c].mean(axis=0), centers[c], atol=0.5)
+
+    def test_make_regression_recoverable(self):
+        x, y, coef = rnd.make_regression(RngState(8), 300, 5, noise=0.0)
+        x, y, coef = np.asarray(x), np.asarray(y), np.asarray(coef)
+        fit, *_ = np.linalg.lstsq(x, y, rcond=None)
+        np.testing.assert_allclose(fit, coef[:, 0], rtol=1e-3, atol=1e-2)
+
+    def test_multi_variable_gaussian(self):
+        mean = np.array([1.0, -2.0], np.float32)
+        cov = np.array([[2.0, 0.8], [0.8, 1.0]], np.float32)
+        x = np.asarray(rnd.multi_variable_gaussian(RngState(9), 20000, mean, cov))
+        np.testing.assert_allclose(x.mean(axis=0), mean, atol=0.1)
+        np.testing.assert_allclose(np.cov(x.T), cov, atol=0.1)
+
+
+class TestRmat:
+    def test_bounds_and_shape(self):
+        theta = np.full((12, 4), 0.25, np.float32)
+        edges = np.asarray(rnd.rmat(RngState(11), 5000, theta, 12, 10))
+        assert edges.shape == (5000, 2)
+        assert edges[:, 0].max() < 2**12 and edges[:, 0].min() >= 0
+        assert edges[:, 1].max() < 2**10
+
+    def test_uniform_theta_is_uniform(self):
+        theta = np.full((8, 4), 0.25, np.float32)
+        edges = np.asarray(rnd.rmat(RngState(12), 50000, theta, 8, 8))
+        # with uniform theta, mean src ≈ (2^8 - 1)/2
+        assert abs(edges[:, 0].mean() - 127.5) < 3.0
+
+    def test_skewed_theta_concentrates(self):
+        # heavy 'a' quadrant → ids concentrate near 0
+        theta = np.tile(np.array([[0.7, 0.1, 0.1, 0.1]], np.float32), (8, 1))
+        edges = np.asarray(rnd.rmat(RngState(13), 20000, theta, 8, 8))
+        assert edges[:, 0].mean() < 60
+        assert np.bincount(edges[:, 0], minlength=256)[0] > 200

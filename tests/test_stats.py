@@ -1,0 +1,151 @@
+"""stats tests — parity with ``cpp/tests/stats/`` (23 suites): validated
+against numpy formulations and known closed-form cases."""
+
+import numpy as np
+import pytest
+
+from raft_tpu import stats
+from raft_tpu.stats import IC_Type
+
+
+def assert_close(a, b, rtol=1e-4, atol=1e-5):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=rtol, atol=atol)
+
+
+class TestSummary:
+    def test_mean_stddev_sum(self, rng):
+        x = rng.standard_normal((50, 6)).astype(np.float32)
+        assert_close(stats.mean(x), x.mean(axis=0), rtol=1e-4)
+        assert_close(stats.stddev(x), x.std(axis=0, ddof=1), rtol=1e-3)
+        assert_close(stats.sum(x), x.sum(axis=0), rtol=1e-4)
+
+    def test_meanvar_center(self, rng):
+        x = rng.standard_normal((40, 5)).astype(np.float32)
+        mu, var = stats.meanvar(x)
+        assert_close(mu, x.mean(axis=0), rtol=1e-4)
+        assert_close(var, x.var(axis=0, ddof=1), rtol=1e-3)
+        centered = np.asarray(stats.mean_center(x))
+        assert_close(centered.mean(axis=0), np.zeros(5), atol=1e-5)
+        assert_close(stats.mean_add(centered, x.mean(axis=0)), x, rtol=1e-4)
+
+    def test_minmax_cov(self, rng):
+        x = rng.standard_normal((100, 4)).astype(np.float32)
+        mn, mx = stats.minmax(x)
+        assert_close(mn, x.min(axis=0))
+        assert_close(mx, x.max(axis=0))
+        assert_close(stats.cov(x), np.cov(x.T), rtol=1e-3, atol=1e-4)
+
+    def test_weighted_mean(self, rng):
+        x = rng.standard_normal((10, 3)).astype(np.float32)
+        w = rng.random(10).astype(np.float32)
+        assert_close(stats.weighted_mean(x, w), (x * w[:, None]).sum(0) / w.sum(), rtol=1e-4)
+
+    def test_histogram(self, rng):
+        x = rng.random((1000, 1)).astype(np.float32)
+        h = np.asarray(stats.histogram(x, 10, 0.0, 1.0))[:, 0]
+        ref, _ = np.histogram(x[:, 0], bins=10, range=(0, 1))
+        np.testing.assert_array_equal(h, ref)
+
+    def test_dispersion(self):
+        centroids = np.array([[0.0, 0.0], [4.0, 0.0]], np.float32)
+        sizes = np.array([10, 10], np.float32)
+        # global centroid (2,0); each centroid at distance 2 → sqrt(20*4)
+        assert_close(stats.dispersion(centroids, sizes), np.sqrt(80.0), rtol=1e-5)
+
+
+class TestMetrics:
+    def test_accuracy(self):
+        assert float(stats.accuracy([1, 2, 3, 4], [1, 2, 0, 4])) == pytest.approx(0.75)
+
+    def test_r2(self, rng):
+        y = rng.standard_normal(100).astype(np.float32)
+        assert float(stats.r2_score(y, y)) == pytest.approx(1.0)
+        assert float(stats.r2_score(y, np.full_like(y, y.mean()))) == pytest.approx(0.0, abs=1e-5)
+
+    def test_regression_metrics(self):
+        p = np.array([1.0, 2.0, 3.0], np.float32)
+        r = np.array([2.0, 2.0, 5.0], np.float32)
+        m = stats.regression_metrics(p, r)
+        assert float(m.mean_abs_error) == pytest.approx(1.0)
+        assert float(m.mean_squared_error) == pytest.approx(5 / 3, rel=1e-5)
+        assert float(m.median_abs_error) == pytest.approx(1.0)
+
+    def test_contingency(self):
+        c = np.asarray(stats.contingency_matrix([0, 0, 1, 1], [0, 1, 1, 1]))
+        np.testing.assert_array_equal(c, [[1, 1], [0, 2]])
+
+
+class TestClusteringMetrics:
+    def test_perfect_and_permuted_labels(self):
+        y = np.array([0, 0, 1, 1, 2, 2])
+        y_perm = np.array([1, 1, 2, 2, 0, 0])  # same partition, renamed
+        assert float(stats.adjusted_rand_index(y, y_perm)) == pytest.approx(1.0)
+        assert float(stats.v_measure(y, y_perm)) == pytest.approx(1.0)
+        assert float(stats.homogeneity_score(y, y_perm)) == pytest.approx(1.0)
+        assert float(stats.completeness_score(y, y_perm)) == pytest.approx(1.0)
+
+    def test_random_labels_near_zero_ari(self, rng):
+        a = rng.integers(0, 5, 2000)
+        b = rng.integers(0, 5, 2000)
+        assert abs(float(stats.adjusted_rand_index(a, b))) < 0.02
+
+    def test_entropy(self):
+        # uniform over 4 classes → ln(4)
+        y = np.repeat(np.arange(4), 25)
+        assert float(stats.entropy(y)) == pytest.approx(np.log(4), rel=1e-4)
+
+    def test_mutual_info_identical(self):
+        y = np.repeat(np.arange(3), 10)
+        assert float(stats.mutual_info_score(y, y)) == pytest.approx(np.log(3), rel=1e-4)
+
+    def test_rand_index(self):
+        assert float(stats.rand_index([0, 0, 1, 1], [0, 0, 1, 1])) == pytest.approx(1.0)
+
+    def test_kl_divergence(self):
+        p = np.array([0.5, 0.5], np.float32)
+        q = np.array([0.25, 0.75], np.float32)
+        ref = 0.5 * np.log(2) + 0.5 * np.log(2 / 3)
+        assert float(stats.kl_divergence(p, q)) == pytest.approx(ref, rel=1e-4)
+
+    def test_silhouette_clear_clusters(self, rng):
+        a = rng.standard_normal((50, 2)).astype(np.float32) * 0.1
+        b = a + 10.0
+        x = np.concatenate([a, b])
+        y = np.array([0] * 50 + [1] * 50)
+        s = float(stats.silhouette_score(x, y))
+        assert s > 0.95
+        # batched variant agrees
+        s_b = float(stats.silhouette_score(x, y, batch_size=16))
+        assert s_b == pytest.approx(s, rel=1e-3)
+
+    def test_information_criterion(self):
+        ll = np.array([-100.0], np.float32)
+        aic = float(stats.information_criterion_batched(ll, IC_Type.AIC, 3, 50)[0])
+        bic = float(stats.information_criterion_batched(ll, IC_Type.BIC, 3, 50)[0])
+        assert aic == pytest.approx(206.0)
+        assert bic == pytest.approx(200 + 3 * np.log(50), rel=1e-5)
+
+
+class TestNeighborhood:
+    def test_recall_perfect_and_partial(self):
+        ref = np.array([[0, 1, 2], [3, 4, 5]])
+        assert float(stats.neighborhood_recall(ref, ref)) == pytest.approx(1.0)
+        got = np.array([[0, 1, 9], [3, 4, 5]])
+        assert float(stats.neighborhood_recall(got, ref)) == pytest.approx(5 / 6, rel=1e-5)
+
+    def test_recall_distance_ties(self):
+        ref = np.array([[0, 1]])
+        got = np.array([[0, 9]])  # wrong id but identical distance
+        d = np.array([[0.0, 1.0]], np.float32)
+        assert float(stats.neighborhood_recall(got, ref, distances=d, ref_distances=d)) == 1.0
+
+    def test_trustworthiness_identity_embedding(self, rng):
+        x = rng.standard_normal((60, 5)).astype(np.float32)
+        t = float(stats.trustworthiness_score(x, x.copy(), n_neighbors=5))
+        assert t == pytest.approx(1.0, abs=1e-5)
+
+    def test_trustworthiness_random_embedding_lower(self, rng):
+        x = rng.standard_normal((60, 5)).astype(np.float32)
+        e = rng.standard_normal((60, 2)).astype(np.float32)
+        t = float(stats.trustworthiness_score(x, e, n_neighbors=5))
+        assert t < 0.95
