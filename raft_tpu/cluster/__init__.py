@@ -1,0 +1,33 @@
+"""raft_tpu.cluster — kmeans / kmeans_balanced (north-star config #3).
+
+The reference's kmeans migrated to cuVS; capability is rebuilt TPU-first:
+assignment is the fused L2 argmin (MXU gemm, ``distance.fused_l2_nn``),
+centroid update is a segment-sum (scatter-add), and everything is a
+``lax.scan``/``while_loop`` over static shapes so the whole fit jit-compiles
+to one XLA program.  Sharded fit = per-shard partial sums + ``psum`` over the
+mesh axis (the MNMG kmeans pattern of SURVEY.md §2.9 item 4).
+"""
+
+from .kmeans import (
+    KMeansParams,
+    kmeans_fit,
+    kmeans_predict,
+    kmeans_fit_predict,
+    kmeans_transform,
+    kmeans_balanced_fit,
+    kmeans_balanced_predict,
+    kmeans_balanced_fit_predict,
+    kmeans_plus_plus_init,
+)
+
+__all__ = [
+    "KMeansParams",
+    "kmeans_fit",
+    "kmeans_predict",
+    "kmeans_fit_predict",
+    "kmeans_transform",
+    "kmeans_balanced_fit",
+    "kmeans_balanced_predict",
+    "kmeans_balanced_fit_predict",
+    "kmeans_plus_plus_init",
+]

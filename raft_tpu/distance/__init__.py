@@ -1,0 +1,21 @@
+"""raft_tpu.distance — pairwise distance metrics, TPU-native.
+
+Capability parity with the RAFT/cuVS pairwise-distance layer the reference
+delegates to (``/root/reference/README.md:96-119`` shows the cuVS API the
+reference now points users at; the in-tree ancestor is the tiled contraction
+engine ``cpp/include/raft/linalg/detail/contractions.cuh:16``).  TPU design:
+expanded metrics (L2/cosine/inner-product/correlation) ride the MXU as one
+``X @ Y.T`` plus rank-1 corrections; unexpanded metrics (L1, Chebyshev,
+Canberra, ...) use a database-tiled ``lax.scan`` so the broadcast difference
+tensor never exceeds one tile.
+"""
+
+from .pairwise import DistanceType, pairwise_distance
+from .fused import fused_l2_nn, fused_l2_nn_argmin
+
+__all__ = [
+    "DistanceType",
+    "pairwise_distance",
+    "fused_l2_nn",
+    "fused_l2_nn_argmin",
+]

@@ -1,0 +1,75 @@
+"""Fused L2 nearest-neighbor — capability parity with RAFT's ``fusedL2NN``
+(named in the north star; descended from the tiled contraction engine
+``cpp/include/raft/linalg/detail/contractions.cuh:16``).
+
+For each query row, find the single nearest database row without ever
+materializing the full (m, n) distance matrix: scan database tiles, compute a
+(m, tile) distance block on the MXU, and fold a running (min_val, min_idx)
+pair.  This is the inner loop of kmeans assignment and 1-NN, so it must be
+pure gemm + elementwise — XLA fuses the correction and min into the matmul
+epilogue.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.array import wrap_array
+from ..core.errors import expects
+
+__all__ = ["fused_l2_nn", "fused_l2_nn_argmin"]
+
+
+@partial(jax.jit, static_argnames=("sqrt", "tile"))
+def _fused_l2_nn(x, y, sqrt: bool, tile: int) -> Tuple[jax.Array, jax.Array]:
+    m, d = x.shape
+    n = y.shape[0]
+    pad = (-n) % tile
+    INF = jnp.float32(jnp.inf)
+    if pad:
+        y = jnp.concatenate([y, jnp.zeros((pad, d), y.dtype)], axis=0)
+    ytiles = y.reshape(-1, tile, d)
+    xf = x.astype(jnp.float32)
+    xn = jnp.sum(xf * xf, axis=1)  # (m,)
+
+    def step(carry, inp):
+        best_val, best_idx = carry
+        t, yt = inp
+        ytf = yt.astype(jnp.float32)
+        yn = jnp.sum(ytf * ytf, axis=1)  # (tile,)
+        dots = jnp.dot(x, yt.T, preferred_element_type=jnp.float32)
+        d2 = xn[:, None] + yn[None, :] - 2.0 * dots
+        d2 = jnp.maximum(d2, 0.0)
+        # mask padded rows of the final tile
+        col = t * tile + jnp.arange(tile)
+        d2 = jnp.where(col[None, :] < n, d2, INF)
+        loc = jnp.argmin(d2, axis=1)
+        val = jnp.take_along_axis(d2, loc[:, None], axis=1)[:, 0]
+        idx = t * tile + loc
+        upd = val < best_val
+        return (jnp.where(upd, val, best_val), jnp.where(upd, idx, best_idx)), None
+
+    init = (jnp.full((m,), INF), jnp.zeros((m,), jnp.int32))
+    (best_val, best_idx), _ = jax.lax.scan(
+        step, init, (jnp.arange(ytiles.shape[0], dtype=jnp.int32), ytiles)
+    )
+    if sqrt:
+        best_val = jnp.sqrt(best_val)
+    return best_val, best_idx
+
+
+def fused_l2_nn(x, y, *, sqrt: bool = False, tile: int = 4096, res=None):
+    """``(min_dist, argmin)`` of L2 distance from each x row to y rows."""
+    x = wrap_array(x, ndim=2, name="x")
+    y = wrap_array(y, ndim=2, name="y")
+    expects(x.shape[1] == y.shape[1], f"dim mismatch {x.shape} vs {y.shape}")
+    return _fused_l2_nn(x, y, bool(sqrt), int(min(tile, max(y.shape[0], 1))))
+
+
+def fused_l2_nn_argmin(x, y, *, tile: int = 4096, res=None) -> jax.Array:
+    """Argmin only (the ``fusedL2NNMinReduce`` out_idx path)."""
+    return fused_l2_nn(x, y, sqrt=False, tile=tile, res=res)[1]

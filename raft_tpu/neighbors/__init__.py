@@ -1,0 +1,30 @@
+"""raft_tpu.neighbors — ANN index family (brute-force, IVF-Flat, IVF-PQ,
+CAGRA), designed TPU-first from the north-star capability list
+(``/root/repo/BASELINE.json``) and the TPU-KNN paper (``PAPERS.md``,
+arXiv 2206.14286); the reference migrated these to cuVS so there is no
+in-tree CUDA ancestor (SURVEY.md scope note).
+
+Shared design rules:
+* distance blocks ride the MXU (see ``raft_tpu.distance``),
+* candidate selection is ``matrix.select_k``,
+* index layouts are dense + padded (fixed list sizes / fixed graph degree) so
+  search is static-shape and jit-compiles once,
+* sharded (multi-chip) variants split the database over a mesh axis and merge
+  per-shard top-k via ``all_gather`` — the moral equivalent of the
+  reference's MNMG index shards over ``comms_t`` (SURVEY.md §5.7).
+"""
+
+from . import brute_force
+from .brute_force import knn
+
+__all__ = ["brute_force", "knn"]
+
+
+def __getattr__(name):
+    if name in ("ivf_flat", "ivf_pq", "cagra", "refine"):
+        import importlib
+
+        mod = importlib.import_module(f"raft_tpu.neighbors.{name}")
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'raft_tpu.neighbors' has no attribute {name!r}")

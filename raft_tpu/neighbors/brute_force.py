@@ -1,0 +1,170 @@
+"""Brute-force (exact) k-nearest-neighbors — the ``neighbors::brute_force``
+capability (north-star config #2: SIFT-1M).  No CUDA ancestor in-tree; design
+follows the TPU-KNN paper (PAPERS.md): distances in MXU-sized tiles, top-k
+merged in a running candidate buffer so HBM never holds the (m, n) matrix.
+
+Single-chip: ``knn``.  Multi-chip: ``knn_sharded`` — database rows sharded
+over one mesh axis, each shard computes a local top-k, candidates are
+``all_gather``-ed over ICI and merged (the TPU analog of the reference's MNMG
+index shards + allgather over ``comms_t``, SURVEY.md §5.7).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.array import wrap_array
+from ..core.errors import expects
+
+__all__ = ["knn", "knn_sharded", "tile_knn_merge"]
+
+_NEG_INF = jnp.float32(-jnp.inf)
+
+
+def _tile_distances(x, yt, metric: str, xn=None):
+    """(m, tile) distance block; smaller-is-nearer for all metrics here."""
+    dots = jnp.dot(x, yt.T, preferred_element_type=jnp.float32)
+    if metric == "inner_product":
+        return -dots  # larger dot = nearer → negate so min-select works
+    ytf = yt.astype(jnp.float32)
+    yn = jnp.sum(ytf * ytf, axis=1)
+    if metric in ("sqeuclidean", "euclidean"):
+        d2 = jnp.maximum(xn[:, None] + yn[None, :] - 2.0 * dots, 0.0)
+        return jnp.sqrt(d2) if metric == "euclidean" else d2
+    if metric == "cosine":
+        xnorm = jnp.sqrt(jnp.maximum(xn, 1e-30))
+        ynorm = jnp.sqrt(jnp.maximum(yn, 1e-30))
+        return 1.0 - dots / (xnorm[:, None] * ynorm[None, :])
+    raise ValueError(f"unsupported brute-force metric {metric!r}")
+
+
+def tile_knn_merge(best_val, best_idx, tile_val, tile_idx, k: int):
+    """Merge a new candidate block into the running (m, k) best buffers.
+
+    2k-wide bitonic-style merge via top_k on the concatenation — the XLA
+    analog of the warpsort queue merge (``detail/select_warpsort.cuh``).
+    """
+    vals = jnp.concatenate([best_val, tile_val], axis=1)
+    idxs = jnp.concatenate([best_idx, tile_idx], axis=1)
+    # min-select: top_k picks max, so negate
+    neg, pos = jax.lax.top_k(-vals, k)
+    return -neg, jnp.take_along_axis(idxs, pos, axis=1)
+
+
+@partial(jax.jit, static_argnames=("k", "metric", "tile"))
+def _knn_impl(x, y, k: int, metric: str, tile: int) -> Tuple[jax.Array, jax.Array]:
+    m, d = x.shape
+    n = y.shape[0]
+    pad = (-n) % tile
+    if pad:
+        y = jnp.concatenate([y, jnp.zeros((pad, d), y.dtype)], axis=0)
+    ytiles = y.reshape(-1, tile, d)
+    xf = x.astype(jnp.float32)
+    xn = jnp.sum(xf * xf, axis=1)
+
+    kk = min(k, tile)
+
+    def step(carry, inp):
+        best_val, best_idx = carry
+        t, yt = inp
+        dist = _tile_distances(x, yt, metric, xn)
+        col = t * tile + jnp.arange(tile)
+        dist = jnp.where(col[None, :] < n, dist, jnp.inf)
+        neg, loc = jax.lax.top_k(-dist, kk)
+        tv, ti = -neg, t * tile + loc
+        return tile_knn_merge(best_val, best_idx, tv, ti, k), None
+
+    init = (
+        jnp.full((m, k), jnp.inf, jnp.float32),
+        jnp.zeros((m, k), jnp.int32),
+    )
+    (bv, bi), _ = jax.lax.scan(
+        step, init, (jnp.arange(ytiles.shape[0], dtype=jnp.int32), ytiles)
+    )
+    if metric == "inner_product":
+        bv = -bv  # undo the similarity negation
+    return bv, bi
+
+
+def knn(
+    queries,
+    database,
+    k: int,
+    *,
+    metric: str = "sqeuclidean",
+    tile: int = 8192,
+    res=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact kNN: returns ``(distances, indices)`` of shape (n_queries, k),
+    nearest first.  ``metric`` ∈ {sqeuclidean, euclidean, cosine,
+    inner_product}."""
+    x = wrap_array(queries, ndim=2, name="queries")
+    y = wrap_array(database, ndim=2, name="database")
+    expects(x.shape[1] == y.shape[1], f"dim mismatch {x.shape} vs {y.shape}")
+    expects(k >= 1, "k must be >= 1")
+    expects(k <= y.shape[0], f"k={k} exceeds database size {y.shape[0]}")
+    return _knn_impl(x, y, int(k), metric, int(min(tile, max(y.shape[0], 1))))
+
+
+def knn_sharded(
+    queries,
+    database,
+    k: int,
+    *,
+    mesh: Mesh,
+    axis: str = "shard",
+    metric: str = "sqeuclidean",
+    tile: int = 8192,
+) -> Tuple[jax.Array, jax.Array]:
+    """Database-sharded exact kNN over a mesh axis.
+
+    Each device holds ``n/n_shards`` database rows (queries replicated),
+    computes a local top-k with *global* index numbering, then candidates are
+    gathered over ICI and merged.  One all_gather of (m, k) per shard — tiny
+    vs. the distance FLOPs, so this scales ~linearly until queries replicate
+    poorly.
+    """
+    x = wrap_array(queries, ndim=2, name="queries")
+    y = wrap_array(database, ndim=2, name="database")
+    nsh = mesh.shape[axis]
+    n = y.shape[0]
+    expects(n % nsh == 0, f"database rows {n} not divisible by mesh axis {nsh}")
+    rows = n // nsh
+    kk = min(k, rows)
+
+    def local(xq, ysh):
+        # ysh: (1, rows, d) block of this shard
+        ysh = ysh[0]
+        shard = jax.lax.axis_index(axis)
+        v, i = _knn_impl(xq, ysh, kk, metric, int(min(tile, rows)))
+        if metric == "inner_product":
+            v = -v  # back to smaller-is-nearer for the cross-shard merge
+        gi = i + shard * rows
+        # gather all shards' candidates: (nsh, m, kk)
+        gv = jax.lax.all_gather(v, axis)
+        gidx = jax.lax.all_gather(gi, axis)
+        m = xq.shape[0]
+        gv = jnp.moveaxis(gv, 0, 1).reshape(m, nsh * kk)
+        gidx = jnp.moveaxis(gidx, 0, 1).reshape(m, nsh * kk)
+        neg, pos = jax.lax.top_k(-gv, k)
+        out_v = -neg
+        if metric == "inner_product":
+            out_v = -out_v
+        return out_v, jnp.take_along_axis(gidx, pos, axis=1)
+
+    yb = y.reshape(nsh, rows, y.shape[1])
+    fn = jax.jit(
+        jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(), P(axis)),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+    )
+    return fn(x, yb)

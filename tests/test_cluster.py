@@ -1,0 +1,89 @@
+"""KMeans tests — convergence on separable blobs, sharded-fit equivalence on
+the virtual mesh, balanced variant list-size uniformity."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.cluster import (
+    KMeansParams,
+    kmeans_fit,
+    kmeans_fit_predict,
+    kmeans_predict,
+    kmeans_transform,
+    kmeans_balanced_fit,
+    kmeans_balanced_fit_predict,
+    kmeans_plus_plus_init,
+)
+from raft_tpu.random import RngState, make_blobs
+from raft_tpu.stats import adjusted_rand_index
+
+
+def _blobs(rng, n=512, d=8, k=5, seed=7):
+    x, y = make_blobs(RngState(seed), n, d, n_clusters=k, cluster_std=0.3)
+    return np.asarray(x), np.asarray(y)
+
+
+def test_kmeans_recovers_blobs(rng):
+    x, y = _blobs(rng)
+    p = KMeansParams(n_clusters=5, max_iter=50, seed=1)
+    c, labels, inertia, n_iter = kmeans_fit_predict(x, p)
+    assert c.shape == (5, 8)
+    ari = float(adjusted_rand_index(np.asarray(labels), y))
+    assert ari > 0.95, f"ARI {ari}"
+    assert float(inertia) > 0
+
+
+def test_kmeans_inertia_decreases(rng):
+    x, _ = _blobs(rng, n=256, k=4)
+    p1 = KMeansParams(n_clusters=4, max_iter=1, seed=0)
+    p2 = KMeansParams(n_clusters=4, max_iter=30, seed=0)
+    _, i1, _ = kmeans_fit(x, p1)
+    _, i2, _ = kmeans_fit(x, p2)
+    assert float(i2) <= float(i1) + 1e-3
+
+
+def test_kmeans_predict_transform(rng):
+    x, _ = _blobs(rng, n=128, k=3)
+    c, _, _ = kmeans_fit(x, KMeansParams(n_clusters=3, max_iter=20))
+    labels = np.asarray(kmeans_predict(x, c))
+    t = np.asarray(kmeans_transform(x, c))
+    assert t.shape == (128, 3)
+    np.testing.assert_array_equal(labels, t.argmin(1))
+
+
+def test_kmeans_plus_plus_spread(rng):
+    x, _ = _blobs(rng, n=200, k=4, seed=9)
+    import jax
+
+    c = np.asarray(kmeans_plus_plus_init(jax.random.PRNGKey(0), x, 4))
+    # seeding should pick 4 distinct, well-separated points
+    from scipy.spatial.distance import pdist
+
+    assert pdist(c).min() > 1.0
+
+
+def test_kmeans_sharded_fit(rng, mesh8):
+    x, y = _blobs(rng, n=512, k=4, seed=11)
+    p = KMeansParams(n_clusters=4, max_iter=25, seed=2)
+    c, inertia, _ = kmeans_fit(x, p, mesh=mesh8)
+    labels = np.asarray(kmeans_predict(x, c))
+    ari = float(adjusted_rand_index(labels, y))
+    assert ari > 0.9, f"sharded ARI {ari}"
+
+
+def test_kmeans_balanced_sizes(rng):
+    x, _ = _blobs(rng, n=480, d=6, k=3, seed=5)
+    p = KMeansParams(n_clusters=8, max_iter=30, balanced_penalty=2.0, seed=0)
+    c, sizes, inertia = kmeans_balanced_fit(x, p)
+    sizes = np.asarray(sizes)
+    assert sizes.sum() == 480
+    # balanced: no list more than 3x the target size
+    assert sizes.max() <= 3 * 480 / 8, sizes
+
+
+def test_kmeans_balanced_fit_predict(rng):
+    x, y = _blobs(rng, n=300, d=5, k=5, seed=13)
+    p = KMeansParams(n_clusters=5, max_iter=40, balanced_penalty=0.5, seed=4)
+    c, labels, sizes, _ = kmeans_balanced_fit_predict(x, p)
+    ari = float(adjusted_rand_index(np.asarray(labels), y))
+    assert ari > 0.8, f"balanced ARI {ari}"

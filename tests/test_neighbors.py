@@ -1,0 +1,87 @@
+"""Brute-force kNN tests — exact results vs numpy argsort; sharded variant on
+the virtual 8-device mesh (SURVEY.md §4 TPU translation of LocalCUDACluster)."""
+
+import numpy as np
+import pytest
+import scipy.spatial.distance as spd
+
+from raft_tpu.neighbors import knn
+from raft_tpu.neighbors.brute_force import knn_sharded
+from raft_tpu.stats import neighborhood_recall
+
+
+def _ref_knn(x, y, k, metric="sqeuclidean"):
+    d = spd.cdist(x, y, metric if metric != "inner_product" else "cosine")
+    if metric == "inner_product":
+        d = -(x @ y.T)
+    idx = np.argsort(d, axis=1, kind="stable")[:, :k]
+    return np.take_along_axis(d, idx, axis=1), idx
+
+
+@pytest.mark.parametrize("metric", ["sqeuclidean", "euclidean", "cosine"])
+def test_knn_exact(rng, metric):
+    x = rng.standard_normal((25, 10)).astype(np.float32)
+    y = rng.standard_normal((200, 10)).astype(np.float32)
+    ref_d, ref_i = _ref_knn(x, y, 5, metric)
+    d, i = knn(x, y, 5, metric=metric, tile=64)
+    np.testing.assert_allclose(np.asarray(d), ref_d, rtol=1e-3, atol=1e-3)
+    # indices can differ on exact ties; compare via recall
+    rec = float(neighborhood_recall(np.asarray(i), ref_i))
+    assert rec >= 0.999
+
+
+def test_knn_inner_product(rng):
+    x = rng.standard_normal((12, 8)).astype(np.float32)
+    y = rng.standard_normal((90, 8)).astype(np.float32)
+    sims = x @ y.T
+    ref_i = np.argsort(-sims, axis=1)[:, :4]
+    d, i = knn(x, y, 4, metric="inner_product", tile=32)
+    assert float(neighborhood_recall(np.asarray(i), ref_i)) >= 0.999
+    # returned "distances" are similarities, descending
+    got = np.asarray(d)
+    assert np.all(np.diff(got, axis=1) <= 1e-5)
+
+
+def test_knn_k1_and_padding(rng):
+    x = rng.standard_normal((5, 3)).astype(np.float32)
+    y = rng.standard_normal((17, 3)).astype(np.float32)  # not multiple of tile
+    d, i = knn(x, y, 1, tile=8)
+    ref = spd.cdist(x, y, "sqeuclidean")
+    np.testing.assert_array_equal(np.asarray(i)[:, 0], ref.argmin(1))
+
+
+def test_knn_sorted_output(rng):
+    x = rng.standard_normal((9, 6)).astype(np.float32)
+    y = rng.standard_normal((64, 6)).astype(np.float32)
+    d, _ = knn(x, y, 10, tile=16)
+    d = np.asarray(d)
+    assert np.all(np.diff(d, axis=1) >= -1e-6)
+
+
+def test_knn_sharded_matches_single(rng, mesh8):
+    x = rng.standard_normal((16, 12)).astype(np.float32)
+    y = rng.standard_normal((320, 12)).astype(np.float32)  # 40 rows/shard
+    d_ref, i_ref = knn(x, y, 8)
+    d, i = knn_sharded(x, y, 8, mesh=mesh8)
+    np.testing.assert_allclose(np.asarray(d), np.asarray(d_ref), rtol=1e-4, atol=1e-4)
+    rec = float(neighborhood_recall(np.asarray(i), np.asarray(i_ref)))
+    assert rec >= 0.999
+
+
+def test_knn_sharded_inner_product(rng, mesh8):
+    x = rng.standard_normal((6, 5)).astype(np.float32)
+    y = rng.standard_normal((80, 5)).astype(np.float32)
+    d_ref, i_ref = knn(x, y, 3, metric="inner_product")
+    d, i = knn_sharded(x, y, 3, mesh=mesh8, metric="inner_product")
+    np.testing.assert_allclose(np.asarray(d), np.asarray(d_ref), rtol=1e-4, atol=1e-4)
+
+
+def test_end_to_end_blobs_recall(rng):
+    """SURVEY.md §7 minimum slice: blobs → brute kNN → recall ≈ 1."""
+    from raft_tpu.random import RngState, make_blobs
+
+    x, labels = make_blobs(RngState(3), 256, 16, n_clusters=8)
+    x = np.asarray(x)
+    ref_d, ref_i = _ref_knn(x, x, 10)
+    d, i = knn(x, x, 10, tile=64)
+    assert float(neighborhood_recall(np.asarray(i), ref_i)) >= 0.999
