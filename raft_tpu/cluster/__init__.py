@@ -9,6 +9,7 @@ mesh axis (the MNMG kmeans pattern of SURVEY.md §2.9 item 4).
 """
 
 from .kmeans import (
+    capped_assign,
     KMeansParams,
     kmeans_fit,
     kmeans_predict,
@@ -21,6 +22,7 @@ from .kmeans import (
 )
 
 __all__ = [
+    "capped_assign",
     "KMeansParams",
     "kmeans_fit",
     "kmeans_predict",

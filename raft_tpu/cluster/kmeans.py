@@ -19,6 +19,7 @@ Capability parity targets (no in-tree CUDA ancestor — migrated to cuVS):
 from __future__ import annotations
 
 import dataclasses
+import functools as _functools
 from functools import partial
 from typing import Optional, Tuple
 
@@ -29,6 +30,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..core.array import wrap_array
 from ..core.errors import expects
 from ..distance.fused import _fused_l2_nn
+from ..distance.pairwise import sq_l2
 
 __all__ = [
     "KMeansParams",
@@ -53,7 +55,8 @@ class KMeansParams:
     tol: float = 1e-4
     seed: int = 0
     init: str = "kmeans++"  # "kmeans++" | "random"
-    balanced_penalty: float = 1.0  # only used by balanced variant
+    balanced_penalty: float = 1.0   # soft size penalty during balanced training
+    balanced_max_ratio: float = 2.0  # hard cap = ratio · n/k for balanced lists
 
 
 def _assign(x, centroids, tile: int = 4096):
@@ -154,15 +157,10 @@ def kmeans_fit(
     return _fit_sharded(x, key, p, mesh, axis)
 
 
-def _fit_sharded(x, key, p: KMeansParams, mesh: Mesh, axis: str):
-    nsh = mesh.shape[axis]
-    n, d = x.shape
-    expects(n % nsh == 0, f"rows {n} not divisible by shards {nsh}")
-    k = p.n_clusters
-
-    # init on replicated data view (cheap: k++ on a subsample)
-    sub = x[:: max(1, n // (k * 32))]
-    c0 = kmeans_plus_plus_init(key, sub, k).astype(jnp.float32)
+@_functools.lru_cache(maxsize=64)
+def _sharded_fit_program(mesh: Mesh, axis: str, k: int, max_iter: int, tol: float):
+    """Compile-once sharded Lloyd loop (jit keyed on the static config, not a
+    per-call closure — otherwise every kmeans_fit(mesh=...) call re-traces)."""
 
     def step_fn(c, xs):
         # xs: local (n/nsh, d) rows; c replicated
@@ -174,21 +172,49 @@ def _fit_sharded(x, key, p: KMeansParams, mesh: Mesh, axis: str):
         return _new_centroids(sums, counts, c), inertia
 
     def fit(xs, c0):
-        def body(it, carry):
-            c, _ = carry
-            return step_fn(c, xs)
+        def cond(carry):
+            _, prev, inertia, it = carry
+            return (it < max_iter) & (
+                jnp.abs(prev - inertia) > tol * jnp.maximum(inertia, 1e-30)
+            )
 
-        c, inertia = jax.lax.fori_loop(0, p.max_iter, body, (c0, jnp.float32(jnp.inf)))
-        return c, inertia
+        def body(carry):
+            c, _, inertia, it = carry
+            c2, new_inertia = step_fn(c, xs)
+            return c2, inertia, new_inertia, it + 1
 
-    fit_sharded = jax.jit(
+        c, inertia0 = step_fn(c0, xs)
+        c, _, inertia, it = jax.lax.while_loop(
+            cond, body, (c, jnp.float32(jnp.inf), inertia0, jnp.int32(1))
+        )
+        return c, inertia, it
+
+    return jax.jit(
         jax.shard_map(
-            fit, mesh=mesh, in_specs=(P(axis), P()), out_specs=(P(), P()),
+            fit, mesh=mesh, in_specs=(P(axis), P()), out_specs=(P(), P(), P()),
             check_vma=False,
         )
     )
-    c, inertia = fit_sharded(x, c0)
-    return c.astype(x.dtype), inertia, jnp.int32(p.max_iter)
+
+
+def _fit_sharded(x, key, p: KMeansParams, mesh: Mesh, axis: str):
+    nsh = mesh.shape[axis]
+    n, d = x.shape
+    expects(n % nsh == 0, f"rows {n} not divisible by shards {nsh}")
+    k = p.n_clusters
+
+    if p.init == "kmeans++":
+        # k++ on a subsample (the reference trains coarse centroids on a
+        # subsample too); full-data k++ would serialize n steps
+        sub = x[:: max(1, n // (k * 32))]
+        c0 = kmeans_plus_plus_init(key, sub, k).astype(jnp.float32)
+    else:
+        idx = jax.random.choice(key, n, (k,), replace=False)
+        c0 = x[idx].astype(jnp.float32)
+
+    fit = _sharded_fit_program(mesh, axis, k, p.max_iter, float(p.tol))
+    c, inertia, n_iter = fit(x, c0)
+    return c.astype(x.dtype), inertia, n_iter
 
 
 def kmeans_predict(x, centroids, *, res=None) -> jax.Array:
@@ -214,66 +240,142 @@ def kmeans_transform(x, centroids, *, res=None) -> jax.Array:
 # --------------------------------------------------------------------------
 
 def _assign_balanced(x, c, counts, penalty, n_per):
-    """Assignment with additive size penalty: cost = d² + λ·q·(size/target),
-    where q is the mean quantization error (mean distance to nearest
-    centroid) — the natural scale so the penalty competes with real
-    distances, not with inter-cluster separation."""
-    xf = x.astype(jnp.float32)
-    xn = jnp.sum(xf * xf, axis=1)
-    cf = c.astype(jnp.float32)
-    cn = jnp.sum(cf * cf, axis=1)
-    d2 = jnp.maximum(xn[:, None] + cn[None, :] - 2.0 * jnp.dot(xf, cf.T), 0.0)
-    scale = jnp.mean(jnp.min(d2, axis=1)) + 1e-12
-    cost = d2 + penalty * scale * (counts[None, :] / jnp.maximum(n_per, 1.0))
+    """Assignment with multiplicative size penalty:
+    ``cost = d² · (1 + λ·size/target)``.
+
+    Multiplicative scaling keeps the penalty proportional to the local
+    distance scale: points well inside a cluster stay put, boundary points
+    migrate to less-crowded neighbors — additive penalties either do nothing
+    (scale too small) or shuffle points across unrelated clusters (too
+    large)."""
+    d2 = sq_l2(x, c)
+    cost = d2 * (1.0 + penalty * counts[None, :] / jnp.maximum(n_per, 1.0))
     labels = jnp.argmin(cost, axis=1)
     real = jnp.take_along_axis(d2, labels[:, None], axis=1)[:, 0]
     return labels, real
 
 
-@partial(jax.jit, static_argnames=("k", "max_iter"))
-def _balanced_fit_impl(x, key, k: int, max_iter: int, penalty: float):
+def _within_group_rank(groups, scores, k: int):
+    """Rank of each element among its group, ordered by ascending score."""
+    n = groups.shape[0]
+    perm = jnp.lexsort((scores, groups))
+    counts = jax.ops.segment_sum(jnp.ones((n,), jnp.int32), groups, num_segments=k)
+    starts = jnp.cumsum(counts) - counts
+    rank_sorted = jnp.arange(n, dtype=jnp.int32) - starts[groups[perm]]
+    return jnp.zeros((n,), jnp.int32).at[perm].set(rank_sorted)
+
+
+@partial(jax.jit, static_argnames=("cap",))
+def capped_assign(x, centroids, cap: int):
+    """Capacity-constrained nearest-centroid assignment.
+
+    Every cluster receives at most ``cap`` points; overflow spills to the
+    next-nearest cluster with room.  Per round: each unassigned point
+    requests its nearest non-full cluster, requests are ranked by distance
+    within each cluster, and the closest ``capacity_left`` are accepted.
+    Deterministic, O(rounds · n log n), and the workhorse behind balanced
+    IVF list layouts (dense padded lists need a hard size bound).
+
+    Runs until every point is placed or no progress is possible (all
+    remaining capacity exhausted — only when ``cap·k < n``); leftover points
+    then keep label -1.  While capacity remains, each round accepts at least
+    one point, so termination ≡ completion.
+    """
+    n = x.shape[0]
+    k = centroids.shape[0]
+    d2 = sq_l2(x, centroids)
+    INF = jnp.float32(jnp.inf)
+
+    def cond(carry):
+        labels, counts, prev_left = carry
+        left = jnp.sum((labels < 0).astype(jnp.int32))
+        return (left > 0) & (left != prev_left)
+
+    def round_fn(carry):
+        labels, counts, _ = carry
+        prev_left = jnp.sum((labels < 0).astype(jnp.int32))
+        unassigned = labels < 0
+        full = counts >= cap
+        cost = jnp.where(full[None, :], INF, d2)
+        cand = jnp.argmin(cost, axis=1).astype(jnp.int32)
+        req_d2 = jnp.where(unassigned, jnp.take_along_axis(d2, cand[:, None], 1)[:, 0], INF)
+        rank = _within_group_rank(cand, req_d2, k)
+        room = (cap - counts)[cand]
+        accept = unassigned & (rank < room)
+        labels = jnp.where(accept, cand, labels)
+        counts = counts + jax.ops.segment_sum(
+            accept.astype(jnp.int32), cand, num_segments=k
+        )
+        return labels, counts, prev_left
+
+    labels0 = jnp.full((n,), -1, jnp.int32)
+    counts0 = jnp.zeros((k,), jnp.int32)
+    labels, counts, _ = jax.lax.while_loop(
+        cond, round_fn, (labels0, counts0, jnp.int32(-1))
+    )
+    return labels, counts
+
+
+@partial(jax.jit, static_argnames=("k", "max_iter", "cap"))
+def _balanced_fit_impl(x, key, k: int, max_iter: int, penalty: float, cap: int):
     n = x.shape[0]
     n_per = jnp.float32(n / k)
-    c0 = kmeans_plus_plus_init(key, x, k).astype(jnp.float32)
+    key, init_key = jax.random.split(key)
+    c0 = kmeans_plus_plus_init(init_key, x, k).astype(jnp.float32)
     counts0 = jnp.zeros((k,), jnp.float32)
 
     def body(it, carry):
         c, counts_s, _ = carry
         labels, d2 = _assign_balanced(x, c, counts_s, penalty, n_per)
         sums, cnts = _update(x, labels, k)
-        c2 = _new_centroids(sums, cnts, c)
-        # reseed any empty cluster at one of the worst-assigned points
-        # (slot j empty → j-th farthest point), preventing permanent collapse
-        _, worst_idx = jax.lax.top_k(d2, k)
-        empty = cnts == 0
-        slot = jnp.clip(jnp.cumsum(empty.astype(jnp.int32)) - 1, 0, k - 1)
-        repl = x[worst_idx].astype(jnp.float32)  # (k, d)
-        c2 = jnp.where(empty[:, None], repl[slot], c2)
         # smoothed counts damp the penalty feedback loop (no oscillation)
-        counts_s = 0.5 * counts_s + 0.5 * cnts
-        return c2, counts_s, jnp.sum(d2)
+        return _new_centroids(sums, cnts, c), 0.5 * counts_s + 0.5 * cnts, jnp.sum(d2)
 
-    c, counts_s, inertia = jax.lax.fori_loop(0, max_iter, body, (c0, counts0, jnp.float32(0)))
-    # final hard assignment (with steady-state penalty) gives the list sizes
-    labels, d2 = _assign_balanced(x, c, counts_s, penalty, n_per)
-    _, counts = _update(x, labels, k)
-    return c.astype(x.dtype), counts, jnp.sum(d2)
+    c, _, inertia = jax.lax.fori_loop(0, max_iter, body, (c0, counts0, jnp.float32(0)))
+    # final assignment is capacity-constrained — a hard size bound, which the
+    # soft penalty alone cannot give (winner-take-all between co-located
+    # centroids); one more Lloyd update from the capped labels re-centers.
+    labels, counts = capped_assign(x, c, cap)
+    safe = jnp.maximum(labels, 0)
+    assigned = (labels >= 0).astype(jnp.float32)
+    sums = jax.ops.segment_sum(x.astype(jnp.float32) * assigned[:, None], safe, num_segments=k)
+    cnts = jax.ops.segment_sum(assigned, safe, num_segments=k)
+    c = _new_centroids(sums, cnts, c)
+    return c.astype(x.dtype), labels, counts, inertia
+
+
+def _balanced_cap(p: KMeansParams, n: int) -> int:
+    return int(-(-p.balanced_max_ratio * n // p.n_clusters))
 
 
 def kmeans_balanced_fit(x, params: Optional[KMeansParams] = None, *, res=None):
-    """Balanced fit → ``(centroids, cluster_sizes, inertia)``."""
+    """Balanced fit → ``(centroids, cluster_sizes, inertia)``.
+
+    List sizes obey the hard bound ``balanced_max_ratio · n/k`` (capacity-
+    constrained final assignment)."""
     p = params or KMeansParams()
     x = wrap_array(x, ndim=2, name="x")
     expects(p.n_clusters <= x.shape[0], "n_clusters exceeds n_rows")
     key = jax.random.PRNGKey(p.seed)
-    return _balanced_fit_impl(x, key, p.n_clusters, p.max_iter, p.balanced_penalty)
+    c, _, counts, inertia = _balanced_fit_impl(
+        x, key, p.n_clusters, p.max_iter, p.balanced_penalty, _balanced_cap(p, x.shape[0])
+    )
+    return c, counts, inertia
 
 
 def kmeans_balanced_predict(x, centroids, *, res=None) -> jax.Array:
-    """Plain nearest-centroid labels (the penalty only shapes training)."""
+    """Plain nearest-centroid labels (the cap only shapes the build)."""
     return kmeans_predict(x, centroids)
 
 
 def kmeans_balanced_fit_predict(x, params: Optional[KMeansParams] = None, *, res=None):
-    c, sizes, inertia = kmeans_balanced_fit(x, params)
-    return c, kmeans_balanced_predict(x, c), sizes, inertia
+    """Returns ``(centroids, capped_labels, cluster_sizes, inertia)`` — the
+    labels respect the capacity bound (what an IVF build consumes)."""
+    p = params or KMeansParams()
+    x = wrap_array(x, ndim=2, name="x")
+    expects(p.n_clusters <= x.shape[0], "n_clusters exceeds n_rows")
+    key = jax.random.PRNGKey(p.seed)
+    c, labels, counts, inertia = _balanced_fit_impl(
+        x, key, p.n_clusters, p.max_iter, p.balanced_penalty, _balanced_cap(p, x.shape[0])
+    )
+    return c, labels, counts, inertia

@@ -41,7 +41,11 @@ def _fused_l2_nn(x, y, sqrt: bool, tile: int) -> Tuple[jax.Array, jax.Array]:
         t, yt = inp
         ytf = yt.astype(jnp.float32)
         yn = jnp.sum(ytf * ytf, axis=1)  # (tile,)
-        dots = jnp.dot(x, yt.T, preferred_element_type=jnp.float32)
+        # HIGHEST: default bf16 MXU passes are coarser than neighbor gaps
+        dots = jnp.dot(
+            x, yt.T, preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
         d2 = xn[:, None] + yn[None, :] - 2.0 * dots
         d2 = jnp.maximum(d2, 0.0)
         # mask padded rows of the final tile

@@ -105,13 +105,39 @@ def sq_norm_rows(x: jax.Array) -> jax.Array:
     return jnp.sum(x * x, axis=-1)
 
 
+def sq_l2(x: jax.Array, y: jax.Array) -> jax.Array:
+    """Exact squared-L2 matrix (m, n) in f32 — THE shared recipe.
+
+    One place owns the precision-critical gemm: f32 accumulation +
+    ``Precision.HIGHEST`` (default bf16 MXU passes are coarser than
+    neighbor/centroid gaps) + cancellation clamp.  Everything needing raw
+    squared distances (kmeans assignment, capacity assignment, IVF) must call
+    this, not re-derive it.
+    """
+    xf = x.astype(jnp.float32)
+    yf = y.astype(jnp.float32)
+    dots = jnp.dot(
+        x, y.T, preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    return jnp.maximum(
+        sq_norm_rows(xf)[:, None] + sq_norm_rows(yf)[None, :] - 2.0 * dots, 0.0
+    )
+
+
 def _expanded_distance(x, y, metric: DistanceType):
     """Distance from one MXU gemm + rank-1 norm corrections.
 
-    Accumulate in f32 regardless of input dtype: bf16 inputs still hit the
-    MXU (jnp.dot with preferred_element_type=f32), norms are exact in f32.
+    Accumulate in f32 regardless of input dtype.  Precision.HIGHEST matters
+    on TPU: the default MXU path multiplies in bf16 whose ~8-bit mantissa is
+    coarser than intra-cluster distance gaps, silently wrecking neighbor
+    ranking (observed recall@10 0.67 vs 1.0).  HIGHEST selects the multi-pass
+    f32-equivalent MXU algorithm.
     """
-    dots = jnp.dot(x, y.T, preferred_element_type=jnp.float32)
+    dots = jnp.dot(
+        x, y.T, preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
     if metric is DistanceType.InnerProduct:
         return dots
     if metric in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded):

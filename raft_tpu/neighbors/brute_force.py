@@ -11,6 +11,7 @@ index shards + allgather over ``comms_t``, SURVEY.md §5.7).
 
 from __future__ import annotations
 
+import functools
 from functools import partial
 from typing import Optional, Tuple
 
@@ -28,7 +29,11 @@ _NEG_INF = jnp.float32(-jnp.inf)
 
 def _tile_distances(x, yt, metric: str, xn=None):
     """(m, tile) distance block; smaller-is-nearer for all metrics here."""
-    dots = jnp.dot(x, yt.T, preferred_element_type=jnp.float32)
+    # HIGHEST: default bf16 MXU passes are coarser than neighbor gaps
+    dots = jnp.dot(
+        x, yt.T, preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
     if metric == "inner_product":
         return -dots  # larger dot = nearer → negate so min-select works
     ytf = yt.astype(jnp.float32)
@@ -44,16 +49,13 @@ def _tile_distances(x, yt, metric: str, xn=None):
 
 
 def tile_knn_merge(best_val, best_idx, tile_val, tile_idx, k: int):
-    """Merge a new candidate block into the running (m, k) best buffers.
+    """Merge a new candidate block into the running (m, k) best buffers via
+    ``matrix.select_k`` — one selection primitive owns all top-k tuning."""
+    from ..matrix.select_k import select_k
 
-    2k-wide bitonic-style merge via top_k on the concatenation — the XLA
-    analog of the warpsort queue merge (``detail/select_warpsort.cuh``).
-    """
     vals = jnp.concatenate([best_val, tile_val], axis=1)
     idxs = jnp.concatenate([best_idx, tile_idx], axis=1)
-    # min-select: top_k picks max, so negate
-    neg, pos = jax.lax.top_k(-vals, k)
-    return -neg, jnp.take_along_axis(idxs, pos, axis=1)
+    return select_k(vals, k, in_idx=idxs, select_min=True)
 
 
 @partial(jax.jit, static_argnames=("k", "metric", "tile"))
@@ -111,6 +113,43 @@ def knn(
     return _knn_impl(x, y, int(k), metric, int(min(tile, max(y.shape[0], 1))))
 
 
+@functools.lru_cache(maxsize=64)
+def _sharded_knn_program(mesh: Mesh, axis: str, rows: int, k: int, kk: int, metric: str, tile: int):
+    """Compile-once sharded search: jit keyed on the static config instead of
+    a per-call closure (which would re-trace every knn_sharded call)."""
+    nsh = mesh.shape[axis]
+
+    def local(xq, ysh):
+        # ysh: (1, rows, d) block of this shard
+        ysh = ysh[0]
+        shard = jax.lax.axis_index(axis)
+        v, i = _knn_impl(xq, ysh, kk, metric, tile)
+        if metric == "inner_product":
+            v = -v  # back to smaller-is-nearer for the cross-shard merge
+        gi = i + shard * rows
+        # gather all shards' candidates: (nsh, m, kk)
+        gv = jax.lax.all_gather(v, axis)
+        gidx = jax.lax.all_gather(gi, axis)
+        m = xq.shape[0]
+        gv = jnp.moveaxis(gv, 0, 1).reshape(m, nsh * kk)
+        gidx = jnp.moveaxis(gidx, 0, 1).reshape(m, nsh * kk)
+        neg, pos = jax.lax.top_k(-gv, k)
+        out_v = -neg
+        if metric == "inner_product":
+            out_v = -out_v
+        return out_v, jnp.take_along_axis(gidx, pos, axis=1)
+
+    return jax.jit(
+        jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(), P(axis)),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+    )
+
+
 def knn_sharded(
     queries,
     database,
@@ -136,35 +175,6 @@ def knn_sharded(
     expects(n % nsh == 0, f"database rows {n} not divisible by mesh axis {nsh}")
     rows = n // nsh
     kk = min(k, rows)
-
-    def local(xq, ysh):
-        # ysh: (1, rows, d) block of this shard
-        ysh = ysh[0]
-        shard = jax.lax.axis_index(axis)
-        v, i = _knn_impl(xq, ysh, kk, metric, int(min(tile, rows)))
-        if metric == "inner_product":
-            v = -v  # back to smaller-is-nearer for the cross-shard merge
-        gi = i + shard * rows
-        # gather all shards' candidates: (nsh, m, kk)
-        gv = jax.lax.all_gather(v, axis)
-        gidx = jax.lax.all_gather(gi, axis)
-        m = xq.shape[0]
-        gv = jnp.moveaxis(gv, 0, 1).reshape(m, nsh * kk)
-        gidx = jnp.moveaxis(gidx, 0, 1).reshape(m, nsh * kk)
-        neg, pos = jax.lax.top_k(-gv, k)
-        out_v = -neg
-        if metric == "inner_product":
-            out_v = -out_v
-        return out_v, jnp.take_along_axis(gidx, pos, axis=1)
-
+    fn = _sharded_knn_program(mesh, axis, rows, int(k), kk, metric, int(min(tile, rows)))
     yb = y.reshape(nsh, rows, y.shape[1])
-    fn = jax.jit(
-        jax.shard_map(
-            local,
-            mesh=mesh,
-            in_specs=(P(), P(axis)),
-            out_specs=(P(), P()),
-            check_vma=False,
-        )
-    )
     return fn(x, yb)
