@@ -1,0 +1,82 @@
+"""Headline benchmark — exact brute-force kNN throughput (SIFT-1M shape).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Config mirrors the driver ladder entry "neighbors::brute_force kNN on
+SIFT-1M" (`BASELINE.json` configs[1]): 1M × 128 float32 database, 10k
+queries, k=10.  The reference repo publishes no numbers ("published": {});
+``vs_baseline`` therefore reports against the recorded best of PREVIOUS
+rounds of this repo (ratcheted in BENCH_HISTORY.json) — 1.0 on first run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+N_DB = 1_000_000
+N_QUERY = 10_000
+DIM = 128
+K = 10
+HISTORY = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_HISTORY.json")
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.neighbors.brute_force import _knn_impl
+
+    key = jax.random.PRNGKey(42)
+    kq, kd = jax.random.split(key)
+    db = jax.random.normal(kd, (N_DB, DIM), jnp.float32)
+    q = jax.random.normal(kq, (N_QUERY, DIM), jnp.float32)
+    db = jax.block_until_ready(db)
+    q = jax.block_until_ready(q)
+
+    tile = 65536
+
+    import numpy as np
+
+    def run():
+        d, i = _knn_impl(q, db, K, "sqeuclidean", tile)
+        # sync via host fetch: on the axon tunnel backend block_until_ready
+        # returns before execution finishes; fetching the (small) outputs is
+        # the only reliable barrier, and its transfer cost is negligible.
+        return np.asarray(d), np.asarray(i)
+
+    run()  # compile + warm
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        run()
+        times.append(time.perf_counter() - t0)
+    qps = N_QUERY / min(times)
+
+    prev = None
+    try:
+        with open(HISTORY) as f:
+            prev = json.load(f).get("knn_qps")
+    except (OSError, json.JSONDecodeError):
+        pass
+    vs = (qps / prev) if prev else 1.0
+    try:
+        with open(HISTORY, "w") as f:
+            json.dump({"knn_qps": max(qps, prev or 0.0)}, f)
+    except OSError:
+        pass
+
+    print(json.dumps({
+        "metric": "brute_force_knn_qps_1Mx128_k10",
+        "value": round(qps, 2),
+        "unit": "queries/s",
+        "vs_baseline": round(vs, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

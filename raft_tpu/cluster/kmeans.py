@@ -34,6 +34,7 @@ from ..distance.pairwise import sq_l2
 
 __all__ = [
     "KMeansParams",
+    "capped_assign",
     "kmeans_plus_plus_init",
     "kmeans_fit",
     "kmeans_predict",
@@ -320,18 +321,24 @@ def capped_assign(x, centroids, cap: int):
 def _balanced_fit_impl(x, key, k: int, max_iter: int, penalty: float, cap: int):
     n = x.shape[0]
     n_per = jnp.float32(n / k)
-    key, init_key = jax.random.split(key)
-    c0 = kmeans_plus_plus_init(init_key, x, k).astype(jnp.float32)
+    c0 = kmeans_plus_plus_init(key, x, k).astype(jnp.float32)
     counts0 = jnp.zeros((k,), jnp.float32)
 
     def body(it, carry):
         c, counts_s, _ = carry
         labels, d2 = _assign_balanced(x, c, counts_s, penalty, n_per)
         sums, cnts = _update(x, labels, k)
+        c2 = _new_centroids(sums, cnts, c)
+        # revive genuinely empty clusters (otherwise frozen forever): slot
+        # j-th empty centroid onto the j-th worst-assigned point
+        empty = cnts == 0
+        _, worst = jax.lax.top_k(d2, k)
+        slot = jnp.clip(jnp.cumsum(empty.astype(jnp.int32)) - 1, 0, k - 1)
+        c2 = jnp.where(empty[:, None], x[worst[slot]].astype(jnp.float32), c2)
         # smoothed counts damp the penalty feedback loop (no oscillation)
-        return _new_centroids(sums, cnts, c), 0.5 * counts_s + 0.5 * cnts, jnp.sum(d2)
+        return c2, 0.5 * counts_s + 0.5 * cnts, jnp.sum(d2)
 
-    c, _, inertia = jax.lax.fori_loop(0, max_iter, body, (c0, counts0, jnp.float32(0)))
+    c, _, _ = jax.lax.fori_loop(0, max_iter, body, (c0, counts0, jnp.float32(0)))
     # final assignment is capacity-constrained — a hard size bound, which the
     # soft penalty alone cannot give (winner-take-all between co-located
     # centroids); one more Lloyd update from the capped labels re-centers.
@@ -341,6 +348,11 @@ def _balanced_fit_impl(x, key, k: int, max_iter: int, penalty: float, cap: int):
     sums = jax.ops.segment_sum(x.astype(jnp.float32) * assigned[:, None], safe, num_segments=k)
     cnts = jax.ops.segment_sum(assigned, safe, num_segments=k)
     c = _new_centroids(sums, cnts, c)
+    # inertia measured against the RETURNED centroids and labels (a stale
+    # training-loop value would mislead seed/penalty sweeps)
+    d2_final = sq_l2(x, c)
+    real = jnp.take_along_axis(d2_final, safe[:, None], axis=1)[:, 0]
+    inertia = jnp.sum(real * assigned)
     return c.astype(x.dtype), labels, counts, inertia
 
 
@@ -348,34 +360,31 @@ def _balanced_cap(p: KMeansParams, n: int) -> int:
     return int(-(-p.balanced_max_ratio * n // p.n_clusters))
 
 
-def kmeans_balanced_fit(x, params: Optional[KMeansParams] = None, *, res=None):
-    """Balanced fit → ``(centroids, cluster_sizes, inertia)``.
-
-    List sizes obey the hard bound ``balanced_max_ratio · n/k`` (capacity-
-    constrained final assignment)."""
+def kmeans_balanced_fit_predict(x, params: Optional[KMeansParams] = None, *, res=None):
+    """Returns ``(centroids, capped_labels, cluster_sizes, inertia)`` — the
+    labels respect the hard bound ``balanced_max_ratio · n/k`` (what an IVF
+    build consumes).  ``balanced_max_ratio`` must be ≥ 1: below that total
+    capacity cannot hold the dataset and points would be dropped."""
     p = params or KMeansParams()
     x = wrap_array(x, ndim=2, name="x")
     expects(p.n_clusters <= x.shape[0], "n_clusters exceeds n_rows")
+    expects(
+        p.balanced_max_ratio >= 1.0,
+        f"balanced_max_ratio={p.balanced_max_ratio} < 1 cannot hold all points",
+    )
     key = jax.random.PRNGKey(p.seed)
-    c, _, counts, inertia = _balanced_fit_impl(
+    return _balanced_fit_impl(
         x, key, p.n_clusters, p.max_iter, p.balanced_penalty, _balanced_cap(p, x.shape[0])
     )
+
+
+def kmeans_balanced_fit(x, params: Optional[KMeansParams] = None, *, res=None):
+    """Balanced fit → ``(centroids, cluster_sizes, inertia)``; see
+    :func:`kmeans_balanced_fit_predict` for the size-bound contract."""
+    c, _, counts, inertia = kmeans_balanced_fit_predict(x, params, res=res)
     return c, counts, inertia
 
 
 def kmeans_balanced_predict(x, centroids, *, res=None) -> jax.Array:
     """Plain nearest-centroid labels (the cap only shapes the build)."""
     return kmeans_predict(x, centroids)
-
-
-def kmeans_balanced_fit_predict(x, params: Optional[KMeansParams] = None, *, res=None):
-    """Returns ``(centroids, capped_labels, cluster_sizes, inertia)`` — the
-    labels respect the capacity bound (what an IVF build consumes)."""
-    p = params or KMeansParams()
-    x = wrap_array(x, ndim=2, name="x")
-    expects(p.n_clusters <= x.shape[0], "n_clusters exceeds n_rows")
-    key = jax.random.PRNGKey(p.seed)
-    c, labels, counts, inertia = _balanced_fit_impl(
-        x, key, p.n_clusters, p.max_iter, p.balanced_penalty, _balanced_cap(p, x.shape[0])
-    )
-    return c, labels, counts, inertia
