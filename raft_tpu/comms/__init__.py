@@ -1,0 +1,55 @@
+"""Distributed communication layer — TPU-native analog of ``raft/comms``.
+
+Reference parity map (SURVEY.md §2.9):
+
+* ``core/comms.hpp:114`` ``comms_iface`` verb set  → :mod:`raft_tpu.comms.comms`
+  (traced verbs over ``jax.lax`` collectives inside ``shard_map``).
+* ``comms/std_comms.hpp:60,108`` NCCL/UCX factories → :func:`build_comms` /
+  :func:`raft_tpu.comms.bootstrap.init_distributed` (bootstrap collapses to
+  ``jax.distributed.initialize`` + mesh construction).
+* ``comms/comms_test.hpp:23-155`` self-test kernels  → :mod:`raft_tpu.comms.selftest`.
+* ``core/resource/comms.hpp`` handle injection       → ``resources.set_comms``.
+"""
+
+from .comms import (
+    Comms,
+    Op,
+    build_comms,
+    allreduce,
+    reduce,
+    bcast,
+    allgather,
+    allgatherv,
+    gather,
+    gatherv,
+    reducescatter,
+    alltoall,
+    sendrecv,
+    ring_shift,
+    multicast_sendrecv,
+    barrier,
+)
+from .bootstrap import init_distributed, inject_comms_on_resources
+from . import selftest
+
+__all__ = [
+    "Comms",
+    "Op",
+    "build_comms",
+    "allreduce",
+    "reduce",
+    "bcast",
+    "allgather",
+    "allgatherv",
+    "gather",
+    "gatherv",
+    "reducescatter",
+    "alltoall",
+    "sendrecv",
+    "ring_shift",
+    "multicast_sendrecv",
+    "barrier",
+    "init_distributed",
+    "inject_comms_on_resources",
+    "selftest",
+]

@@ -1,0 +1,66 @@
+"""Distributed bootstrap — the raft-dask ``Comms`` analog.
+
+The reference bootstraps MNMG in five Dask/RPC/NCCL steps
+(``raft-dask/raft_dask/common/comms.py:161`` ``init``: worker ranks → NCCL
+uniqueId broadcast → per-worker ``ncclCommInitRank`` → optional UCX endpoint
+mesh → handle injection, SURVEY.md §3.2).  On TPU the whole stack collapses:
+``jax.distributed.initialize`` performs rank/coordination bootstrap, the mesh
+*is* the communicator topology, and "injection" is setting the comms slot on a
+:class:`~raft_tpu.core.resources.Resources`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..core import resources as res_mod
+from ..core.mesh import make_mesh
+from .comms import Comms
+
+__all__ = ["init_distributed", "inject_comms_on_resources"]
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    *,
+    axis_names: Sequence[str] = ("shard",),
+    axis_shape: Optional[Sequence[int]] = None,
+    res: Optional[res_mod.Resources] = None,
+) -> Comms:
+    """Bootstrap a (possibly multi-host) communicator and inject it.
+
+    Single-process: uses local devices directly (LocalCUDACluster-style tests,
+    ``raft-dask/tests/conftest.py:14-49`` parity).  Multi-process: forwards to
+    ``jax.distributed.initialize`` (the ``ncclCommInitRank`` +
+    ``create_nccl_uniqueid`` replacement — coordination service instead of a
+    Dask RPC'd uniqueId).
+    """
+    if num_processes is not None and num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    devices = jax.devices()
+    if axis_shape is None:
+        if len(axis_names) != 1:
+            raise ValueError("axis_shape required for multi-axis meshes")
+        axis_shape = (len(devices),)
+    mesh = make_mesh(tuple(axis_shape), tuple(axis_names))
+    comms = Comms(mesh)
+    target = res_mod._resolve(res)
+    inject_comms_on_resources(target, comms)
+    return comms
+
+
+def inject_comms_on_resources(res: res_mod.Resources, comms: Comms) -> None:
+    """``inject_comms_on_handle`` parity (``common/comms_utils.pyx:248,278``):
+    construct-and-set collapses to setting the comms slot; the mesh slot is
+    aligned so primitives see a consistent topology."""
+    res_mod.set_comms(res, comms)
+    res.set_resource("mesh", comms.mesh)
