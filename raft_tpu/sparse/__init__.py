@@ -1,0 +1,62 @@
+"""Sparse subsystem — TPU-native parity with ``cpp/include/raft/sparse``
+(SURVEY.md §2.5): COO/CSR containers, format conversions, sparse linalg
+(SpMV/SpMM/SDDMM/masked-matmul/laplacian/symmetrize), structural ops,
+text-statistics preprocessing, CSR top-k, and the solver family
+(Lanczos, randomized SVD, MST) under :mod:`raft_tpu.sparse.solver`.
+"""
+
+from .types import COO, CSR
+from .convert import (
+    adj_to_csr,
+    bitmap_to_csr,
+    bitset_to_csr,
+    coo_to_csr,
+    coo_to_dense,
+    csr_to_coo,
+    csr_to_dense,
+    dense_to_coo,
+    dense_to_csr,
+    sorted_coo_to_csr,
+)
+from .linalg import (
+    compute_graph_laplacian,
+    coo_degree,
+    coo_symmetrize,
+    csr_add,
+    csr_row_norm,
+    csr_row_normalize_l1,
+    csr_row_normalize_max,
+    csr_transpose,
+    masked_matmul,
+    sddmm,
+    spmm,
+    spmv,
+)
+from .ops import (
+    coo_max_duplicates,
+    coo_remove_scalar,
+    coo_remove_zeros,
+    coo_sort,
+    coo_sum_duplicates,
+    csr_diagonal,
+    csr_row_op,
+    csr_set_diagonal,
+    csr_slice_rows,
+)
+from .preprocessing import encode_bm25, encode_tfidf
+from .select_k import csr_select_k
+
+__all__ = [
+    "COO", "CSR",
+    "adj_to_csr", "bitmap_to_csr", "bitset_to_csr", "coo_to_csr",
+    "coo_to_dense", "csr_to_coo", "csr_to_dense", "dense_to_coo",
+    "dense_to_csr", "sorted_coo_to_csr",
+    "compute_graph_laplacian", "coo_degree", "coo_symmetrize", "csr_add",
+    "csr_row_norm", "csr_row_normalize_l1", "csr_row_normalize_max",
+    "csr_transpose", "masked_matmul", "sddmm", "spmm", "spmv",
+    "coo_max_duplicates", "coo_remove_scalar", "coo_remove_zeros", "coo_sort",
+    "coo_sum_duplicates", "csr_diagonal", "csr_row_op", "csr_set_diagonal",
+    "csr_slice_rows",
+    "encode_bm25", "encode_tfidf",
+    "csr_select_k",
+]

@@ -1,0 +1,147 @@
+"""Structural sparse ops — ``sparse/op/*.cuh`` parity.
+
+nnz-changing ops (filter, dedup) can't produce dynamic shapes under XLA; the
+convention here is **compact-in-place**: valid entries are moved to the prefix
+(stable argsort on the keep-mask — the XLA replacement for the reference's
+scan-compact kernels), pads carry sentinel coordinates, and the new nnz is
+returned.  Host-eager callers get exact-size results via ``.trimmed()``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.errors import expects
+from .types import COO, CSR
+
+__all__ = [
+    "coo_sort",
+    "coo_remove_scalar",
+    "coo_remove_zeros",
+    "coo_sum_duplicates",
+    "coo_max_duplicates",
+    "csr_row_op",
+    "csr_slice_rows",
+    "csr_diagonal",
+    "csr_set_diagonal",
+]
+
+
+def coo_sort(coo: COO) -> COO:
+    """Sort by (row, col), pads last (``sparse/op/sort.cuh`` coo_sort — cub
+    radix sort role).  Lexicographic via two stable argsorts — overflow-safe
+    for any shape, and XLA fuses both passes."""
+    pad_rows = jnp.where(coo.pad_mask(), coo.rows, coo.shape[0])
+    order = jnp.argsort(coo.cols, stable=True)
+    order = order[jnp.argsort(pad_rows[order], stable=True)]
+    return COO(coo.rows[order], coo.cols[order], coo.vals[order],
+               coo.shape, coo.nnz)
+
+
+def _compact(coo: COO, keep: jax.Array) -> COO:
+    """Stable-partition kept entries to the prefix; returns new COO whose
+    ``nnz`` is the kept count (host-side int when possible)."""
+    keep = keep & coo.pad_mask()
+    order = jnp.argsort(~keep, stable=True)  # kept first, stable
+    rows = jnp.where(keep[order], coo.rows[order], coo.shape[0])
+    cols = jnp.where(keep[order], coo.cols[order], coo.shape[1])
+    vals = jnp.where(keep[order], coo.vals[order], 0)
+    n_kept = int(jnp.sum(keep))  # host sync: mirrors the reference's
+    # cudaMemcpy of the compacted count (detail/coo.cuh coo_remove_scalar)
+    return COO(rows, cols, vals, coo.shape, n_kept)
+
+
+def coo_remove_scalar(coo: COO, scalar) -> COO:
+    """Drop entries equal to ``scalar`` (``sparse/op/filter.cuh``
+    ``coo_remove_scalar``)."""
+    return _compact(coo, coo.vals != scalar)
+
+
+def coo_remove_zeros(coo: COO) -> COO:
+    return coo_remove_scalar(coo, 0)
+
+
+def _dedup(coo: COO, combine: str) -> COO:
+    """Merge duplicate (row, col) runs after sorting.
+
+    ``sparse/op/reduce.cuh`` keeps the max dupe (``max_duplicates``);
+    symmetrize wants sums.  Segment-combine over run ids keeps everything
+    static-shaped: runs get ids via a prefix sum over "new key" flags.
+    """
+    s = coo_sort(coo)
+    same = (s.rows[1:] == s.rows[:-1]) & (s.cols[1:] == s.cols[:-1]) & s.pad_mask()[1:]
+    new_run = jnp.concatenate([jnp.ones((1,), bool), ~same])
+    run_id = jnp.cumsum(new_run) - 1  # [cap]
+    n_runs = s.capacity  # upper bound for segment ops
+    if combine == "sum":
+        merged = jax.ops.segment_sum(s.vals, run_id, num_segments=n_runs)
+    else:
+        merged = jax.ops.segment_max(s.vals, run_id, num_segments=n_runs)
+    # representative entry of each run = first occurrence
+    first_pos = jnp.where(new_run, jnp.arange(s.capacity), s.capacity)
+    rep = jax.ops.segment_min(first_pos, run_id, num_segments=n_runs)
+    rep_c = jnp.minimum(rep, s.capacity - 1)
+    rows = jnp.where(rep < s.capacity, s.rows[rep_c], s.shape[0])
+    cols = jnp.where(rep < s.capacity, s.cols[rep_c], s.shape[1])
+    valid_run = (rep < s.capacity) & (rows < s.shape[0])
+    vals = jnp.where(valid_run, merged, 0)
+    out = COO(rows.astype(jnp.int32), cols.astype(jnp.int32), vals,
+              s.shape, s.nnz)
+    return _compact(out, valid_run)
+
+
+def coo_sum_duplicates(coo: COO) -> COO:
+    """Merge duplicates by summation (symmetrize contract,
+    ``sparse/linalg/symmetrize.cuh``)."""
+    return _dedup(coo, "sum")
+
+
+def coo_max_duplicates(coo: COO) -> COO:
+    """Keep max duplicate (``sparse/op/reduce.cuh`` ``max_duplicates``)."""
+    return _dedup(coo, "max")
+
+
+def csr_row_op(csr: CSR, fn: Callable) -> CSR:
+    """Apply ``fn(row_id, values) -> values`` across rows
+    (``sparse/op/row_op.cuh`` ``csr_row_op`` — per-row lambda kernel).
+    Vectorized: fn receives the per-element row-id array and data."""
+    rid = csr.row_ids()
+    data = fn(jnp.minimum(rid, csr.n_rows - 1), csr.data)
+    return CSR(csr.indptr, csr.indices, data, csr.shape, csr.nnz)
+
+
+def csr_slice_rows(csr: CSR, start: int, stop: int) -> CSR:
+    """Row-range slice (``sparse/op/slice.cuh`` ``csr_row_slice``).
+
+    Static bounds (host ints) — the reference also computes the value range on
+    the host before launching the copy.
+    """
+    expects(0 <= start <= stop <= csr.n_rows, "row slice out of range")
+    lo = int(csr.indptr[start])
+    hi = int(csr.indptr[stop])
+    indptr = csr.indptr[start : stop + 1] - lo
+    return CSR(indptr, csr.indices[lo:hi], csr.data[lo:hi],
+               (stop - start, csr.n_cols), hi - lo)
+
+
+def csr_diagonal(csr: CSR) -> jax.Array:
+    """Extract the main diagonal (``sparse/matrix/diagonal.cuh``)."""
+    rid, valid = csr.row_ids(), csr.row_ids() < csr.n_rows
+    on_diag = valid & (rid == csr.indices)
+    rid_c = jnp.minimum(rid, csr.n_rows - 1)
+    return jnp.zeros((csr.n_rows,), csr.data.dtype).at[rid_c].add(
+        jnp.where(on_diag, csr.data, 0)
+    )
+
+
+def csr_set_diagonal(csr: CSR, values) -> CSR:
+    """Overwrite existing diagonal entries (``sparse/matrix/diagonal.cuh``
+    ``set_diagonal`` — requires the diagonal to be present in the pattern)."""
+    rid = csr.row_ids()
+    on_diag = (rid < csr.n_rows) & (rid == csr.indices)
+    rid_c = jnp.minimum(rid, csr.n_rows - 1)
+    data = jnp.where(on_diag, jnp.take(values, rid_c), csr.data)
+    return CSR(csr.indptr, csr.indices, data, csr.shape, csr.nnz)

@@ -1,0 +1,59 @@
+"""CSR top-k — ``sparse/matrix/select_k.cuh`` parity.
+
+The reference routes CSR rows through the same radix/warpsort machinery as the
+dense ``matrix::select_k``.  The TPU formulation densifies the ragged rows
+into a ``[n_rows, width]`` tile (width = longest row, padded with ±inf) and
+reuses the dense select_k path — the MXU/VPU have no ragged layout, so this
+is the layout the hardware wants anyway.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..matrix.select_k import SelectAlgo, select_k as dense_select_k
+from .types import CSR
+
+__all__ = ["csr_select_k"]
+
+
+def csr_select_k(
+    csr: CSR,
+    k: int,
+    *,
+    select_min: bool = True,
+    algo: SelectAlgo = SelectAlgo.kAuto,
+) -> Tuple[jax.Array, jax.Array]:
+    """Top-k per CSR row → ``(values, column_indices)`` of ``[n_rows, k]``.
+
+    Rows shorter than ``k`` are padded with ±inf values and ``-1`` indices
+    (the reference's bounds contract for ``select_k``).
+    """
+    width = int(jnp.max(csr.row_lengths())) if csr.n_rows else 0
+    width = max(width, 1)
+    pad = jnp.inf if select_min else -jnp.inf
+
+    rid = csr.row_ids()
+    valid = rid < csr.n_rows
+    rid_c = jnp.minimum(rid, csr.n_rows - 1)
+    pos = jnp.arange(csr.capacity, dtype=jnp.int32) - jnp.take(csr.indptr, rid_c)
+    pos = jnp.clip(pos, 0, width - 1)
+
+    dense_vals = jnp.full((csr.n_rows, width), pad, csr.data.dtype)
+    dense_vals = dense_vals.at[rid_c, pos].set(
+        jnp.where(valid, csr.data, pad), mode="drop"
+    )
+    dense_idx = jnp.full((csr.n_rows, width), -1, jnp.int32)
+    dense_idx = dense_idx.at[rid_c, pos].set(
+        jnp.where(valid, csr.indices, -1), mode="drop"
+    )
+
+    vals, pos_idx = dense_select_k(dense_vals, k, select_min=select_min, algo=algo)
+    cols = jnp.take_along_axis(dense_idx, jnp.clip(pos_idx, 0, width - 1), axis=1)
+    cols = jnp.where(pos_idx >= 0, cols, -1)
+    # entries that selected padding report -1
+    cols = jnp.where(jnp.isfinite(vals), cols, -1)
+    return vals, cols

@@ -1,0 +1,141 @@
+"""Sparse containers — COO/CSR owning types.
+
+Reference parity: ``sparse/coo.hpp`` (``COO<T>`` with RMM-backed rows/cols/vals
+buffers), ``sparse/csr.hpp``, and the core owning types
+(``core/sparse_types.hpp``, ``core/coo_matrix.hpp``, ``core/csr_matrix.hpp``).
+
+TPU-native design: XLA requires static shapes, so a sparse matrix carries a
+static element **capacity**; ``nnz`` is the valid prefix length (a static int
+on the host path).  Padding lives at the tail: COO pad rows/cols are the
+sentinel ``n_rows`` / ``n_cols`` (never a valid coordinate) with zero values,
+CSR pad indices are zeros with zero data beyond ``indptr[-1]``, so segment-sum
+kernels can run over full capacity without masking.  Both types are registered
+pytrees — they pass through ``jit``/``shard_map`` boundaries like arrays.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.errors import expects
+
+__all__ = ["COO", "CSR"]
+
+Shape = Tuple[int, int]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class COO:
+    """Coordinate-format sparse matrix (``sparse/coo.hpp`` ``COO<T>``)."""
+
+    rows: jax.Array  # [cap] int32
+    cols: jax.Array  # [cap] int32
+    vals: jax.Array  # [cap] T
+    shape: Shape = dataclasses.field(metadata=dict(static=True))
+    nnz: int = dataclasses.field(metadata=dict(static=True))
+
+    def __post_init__(self):
+        expects(self.rows.shape == self.cols.shape == self.vals.shape,
+                "COO buffers must share shape")
+
+    @property
+    def capacity(self) -> int:
+        return int(self.rows.shape[0])
+
+    @classmethod
+    def from_arrays(cls, rows, cols, vals, shape: Shape, nnz: Optional[int] = None) -> "COO":
+        rows = jnp.asarray(rows, jnp.int32)
+        cols = jnp.asarray(cols, jnp.int32)
+        vals = jnp.asarray(vals)
+        return cls(rows, cols, vals, (int(shape[0]), int(shape[1])),
+                   int(nnz) if nnz is not None else int(rows.shape[0]))
+
+    @classmethod
+    def from_dense(cls, dense, *, tol: float = 0.0) -> "COO":
+        """Host-eager densification inverse (``convert/coo.cuh`` role)."""
+        d = np.asarray(dense)
+        r, c = np.nonzero(np.abs(d) > tol)
+        return cls.from_arrays(r, c, d[r, c], d.shape)
+
+    def to_dense(self) -> jax.Array:
+        """Scatter-add valid entries into a dense matrix (pads are no-ops
+        because sentinel coordinates fall outside with mode='drop')."""
+        out = jnp.zeros(self.shape, self.vals.dtype)
+        return out.at[self.rows, self.cols].add(self.vals, mode="drop")
+
+    def trimmed(self) -> "COO":
+        """Drop padding (host-side; capacity becomes exact nnz)."""
+        return COO(self.rows[: self.nnz], self.cols[: self.nnz],
+                   self.vals[: self.nnz], self.shape, self.nnz)
+
+    def pad_mask(self) -> jax.Array:
+        """True for valid (non-pad) entries; usable under jit."""
+        return jnp.arange(self.capacity) < self.nnz
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class CSR:
+    """Compressed-sparse-row matrix (``sparse/csr.hpp``)."""
+
+    indptr: jax.Array   # [n_rows+1] int32
+    indices: jax.Array  # [cap] int32
+    data: jax.Array     # [cap] T
+    shape: Shape = dataclasses.field(metadata=dict(static=True))
+    nnz: int = dataclasses.field(metadata=dict(static=True))
+
+    @property
+    def capacity(self) -> int:
+        return int(self.indices.shape[0])
+
+    @property
+    def n_rows(self) -> int:
+        return self.shape[0]
+
+    @property
+    def n_cols(self) -> int:
+        return self.shape[1]
+
+    @classmethod
+    def from_arrays(cls, indptr, indices, data, shape: Shape, nnz: Optional[int] = None) -> "CSR":
+        indptr = jnp.asarray(indptr, jnp.int32)
+        indices = jnp.asarray(indices, jnp.int32)
+        data = jnp.asarray(data)
+        expects(indptr.shape[0] == shape[0] + 1, "indptr must have n_rows+1 entries")
+        return cls(indptr, indices, data, (int(shape[0]), int(shape[1])),
+                   int(nnz) if nnz is not None else int(indices.shape[0]))
+
+    @classmethod
+    def from_dense(cls, dense, *, tol: float = 0.0) -> "CSR":
+        d = np.asarray(dense)
+        r, c = np.nonzero(np.abs(d) > tol)
+        indptr = np.zeros(d.shape[0] + 1, np.int32)
+        np.add.at(indptr, r + 1, 1)
+        indptr = np.cumsum(indptr).astype(np.int32)
+        return cls.from_arrays(indptr, c, d[r, c], d.shape)
+
+    def row_ids(self) -> jax.Array:
+        """Expand indptr → per-element row id ([cap] int32); pads map to
+        ``n_rows``.  The csr_to_coo expansion (``convert/coo.cuh``
+        ``csr_to_coo``) as a searchsorted — one XLA op, no scatter."""
+        pos = jnp.arange(self.capacity, dtype=jnp.int32)
+        rid = jnp.searchsorted(self.indptr[1:], pos, side="right").astype(jnp.int32)
+        return jnp.where(pos < self.nnz, rid, self.n_rows)
+
+    def to_dense(self) -> jax.Array:
+        rid = self.row_ids()
+        out = jnp.zeros(self.shape, self.data.dtype)
+        return out.at[rid, self.indices].add(self.data, mode="drop")
+
+    def row_lengths(self) -> jax.Array:
+        return self.indptr[1:] - self.indptr[:-1]
+
+    def trimmed(self) -> "CSR":
+        return CSR(self.indptr, self.indices[: self.nnz], self.data[: self.nnz],
+                   self.shape, self.nnz)

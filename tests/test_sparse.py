@@ -1,0 +1,241 @@
+"""Sparse subsystem tests — strategy parity with ``cpp/tests/sparse/`` (25
+suites comparing kernels against naive host references, SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import sparse
+from raft_tpu.sparse import COO, CSR
+
+
+def _rand_dense(rng, m, n, density=0.3):
+    d = rng.standard_normal((m, n)).astype(np.float32)
+    mask = rng.random((m, n)) < density
+    return d * mask
+
+
+@pytest.fixture()
+def dense(rng):
+    return _rand_dense(rng, 17, 23)
+
+
+# -- containers / conversions ------------------------------------------------
+
+def test_csr_dense_roundtrip(dense):
+    csr = CSR.from_dense(dense)
+    assert csr.nnz == int(np.count_nonzero(dense))
+    np.testing.assert_allclose(np.asarray(csr.to_dense()), dense)
+
+
+def test_coo_dense_roundtrip(dense):
+    coo = COO.from_dense(dense)
+    np.testing.assert_allclose(np.asarray(coo.to_dense()), dense)
+
+
+def test_coo_csr_conversions(dense):
+    coo = COO.from_dense(dense)
+    csr = sparse.coo_to_csr(coo)
+    np.testing.assert_allclose(np.asarray(csr.to_dense()), dense)
+    back = sparse.csr_to_coo(csr)
+    np.testing.assert_allclose(np.asarray(back.to_dense()), dense)
+
+
+def test_row_ids_with_empty_rows():
+    d = np.zeros((5, 4), np.float32)
+    d[0, 1] = 1.0
+    d[3, 0] = 2.0
+    d[3, 3] = 3.0
+    csr = CSR.from_dense(d)
+    rid = np.asarray(csr.row_ids())
+    np.testing.assert_array_equal(rid, [0, 3, 3])
+
+
+def test_adj_to_csr(rng):
+    adj = rng.random((6, 6)) < 0.4
+    csr = sparse.adj_to_csr(adj)
+    np.testing.assert_allclose(np.asarray(csr.to_dense()), adj.astype(np.float32))
+
+
+def test_bitmap_to_csr():
+    from raft_tpu.core.bitset import Bitmap
+
+    bm = Bitmap.create_2d(3, 5, default_value=False)
+    bm = bm.set2(jnp.asarray([0, 2]), jnp.asarray([1, 4]))
+    csr = sparse.bitmap_to_csr(bm)
+    dense = np.asarray(csr.to_dense())
+    assert dense[0, 1] == 1 and dense[2, 4] == 1 and dense.sum() == 2
+
+
+# -- linalg ------------------------------------------------------------------
+
+def test_spmv(dense, rng):
+    csr = CSR.from_dense(dense)
+    x = rng.standard_normal(dense.shape[1]).astype(np.float32)
+    out = np.asarray(sparse.spmv(csr, jnp.asarray(x)))
+    np.testing.assert_allclose(out, dense @ x, rtol=1e-5, atol=1e-5)
+
+
+def test_spmm(dense, rng):
+    csr = CSR.from_dense(dense)
+    b = rng.standard_normal((dense.shape[1], 7)).astype(np.float32)
+    out = np.asarray(sparse.spmm(csr, jnp.asarray(b)))
+    np.testing.assert_allclose(out, dense @ b, rtol=1e-5, atol=1e-5)
+
+
+def test_spmm_jit_composes(dense, rng):
+    csr = CSR.from_dense(dense)
+    b = jnp.asarray(rng.standard_normal((dense.shape[1], 4)).astype(np.float32))
+    out = jax.jit(lambda m, x: sparse.spmm(m, x))(csr, b)
+    np.testing.assert_allclose(np.asarray(out), dense @ np.asarray(b), rtol=1e-5, atol=1e-5)
+
+
+def test_sddmm(dense, rng):
+    a = rng.standard_normal((17, 9)).astype(np.float32)
+    b = rng.standard_normal((9, 23)).astype(np.float32)
+    mask = CSR.from_dense(dense)
+    out = sparse.sddmm(jnp.asarray(a), jnp.asarray(b), mask, alpha=2.0, beta=0.5)
+    full = 2.0 * (a @ b)
+    want = np.where(dense != 0, full + 0.5 * dense, 0)
+    np.testing.assert_allclose(np.asarray(out.to_dense()), want, rtol=1e-4, atol=1e-4)
+
+
+def test_masked_matmul(dense, rng):
+    a = rng.standard_normal((17, 9)).astype(np.float32)
+    b = rng.standard_normal((23, 9)).astype(np.float32)
+    mask = CSR.from_dense((dense != 0).astype(np.float32))
+    out = sparse.masked_matmul(jnp.asarray(a), jnp.asarray(b), mask)
+    want = np.where(dense != 0, a @ b.T, 0)
+    np.testing.assert_allclose(np.asarray(out.to_dense()), want, rtol=1e-4, atol=1e-4)
+
+
+def test_csr_add(rng):
+    d1 = _rand_dense(rng, 8, 6)
+    d2 = _rand_dense(rng, 8, 6)
+    out = sparse.csr_add(CSR.from_dense(d1), CSR.from_dense(d2))
+    np.testing.assert_allclose(np.asarray(out.to_dense()), d1 + d2, rtol=1e-5, atol=1e-5)
+
+
+def test_degree_and_norms(dense):
+    csr = CSR.from_dense(dense)
+    coo = COO.from_dense(dense)
+    np.testing.assert_array_equal(
+        np.asarray(sparse.coo_degree(coo)), np.count_nonzero(dense, axis=1)
+    )
+    np.testing.assert_allclose(
+        np.asarray(sparse.csr_row_norm(csr, "l1")), np.abs(dense).sum(1), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(sparse.csr_row_norm(csr, "l2")), (dense ** 2).sum(1), rtol=1e-5
+    )
+    l1 = sparse.csr_row_normalize_l1(csr)
+    sums = np.abs(np.asarray(l1.to_dense())).sum(1)
+    nz = np.count_nonzero(dense, axis=1) > 0
+    np.testing.assert_allclose(sums[nz], 1.0, rtol=1e-5)
+
+
+def test_transpose(dense):
+    csr = CSR.from_dense(dense)
+    t = sparse.csr_transpose(csr)
+    np.testing.assert_allclose(np.asarray(t.to_dense()), dense.T)
+
+
+def test_symmetrize(rng):
+    d = _rand_dense(rng, 9, 9)
+    np.fill_diagonal(d, 0)
+    coo = COO.from_dense(d)
+    sym = sparse.coo_symmetrize(coo)
+    np.testing.assert_allclose(np.asarray(sym.to_dense()), d + d.T, rtol=1e-5, atol=1e-6)
+
+
+def test_laplacian(rng):
+    adj_mask = rng.random((10, 10)) < 0.3
+    adj_mask = np.triu(adj_mask, 1)
+    a = (adj_mask | adj_mask.T).astype(np.float32)
+    lap = sparse.compute_graph_laplacian(CSR.from_dense(a))
+    want = np.diag(a.sum(1)) - a
+    np.testing.assert_allclose(np.asarray(lap.to_dense()), want, rtol=1e-5, atol=1e-6)
+
+
+# -- structural ops ----------------------------------------------------------
+
+def test_coo_sort_and_dedup():
+    rows = np.asarray([2, 0, 0, 2, 1], np.int32)
+    cols = np.asarray([1, 3, 3, 1, 0], np.int32)
+    vals = np.asarray([5.0, 1.0, 2.0, 7.0, 3.0], np.float32)
+    coo = COO.from_arrays(rows, cols, vals, (3, 4))
+    summed = sparse.coo_sum_duplicates(coo)
+    dense = np.asarray(summed.to_dense())
+    assert dense[0, 3] == 3.0 and dense[2, 1] == 12.0 and dense[1, 0] == 3.0
+    assert summed.nnz == 3
+    kept = sparse.coo_max_duplicates(coo)
+    dense = np.asarray(kept.to_dense())
+    assert dense[0, 3] == 2.0 and dense[2, 1] == 7.0
+
+
+def test_coo_remove_scalar():
+    coo = COO.from_arrays([0, 0, 1], [0, 1, 2], [1.0, 0.0, 2.0], (2, 3))
+    out = sparse.coo_remove_zeros(coo)
+    assert out.nnz == 2
+    dense = np.asarray(out.to_dense())
+    assert dense[0, 0] == 1.0 and dense[1, 2] == 2.0
+
+
+def test_csr_slice_rows(dense):
+    csr = CSR.from_dense(dense)
+    sl = sparse.csr_slice_rows(csr, 3, 9)
+    np.testing.assert_allclose(np.asarray(sl.to_dense()), dense[3:9])
+
+
+def test_csr_diagonal(rng):
+    d = _rand_dense(rng, 7, 7)
+    np.fill_diagonal(d, np.arange(1, 8))
+    csr = CSR.from_dense(d)
+    np.testing.assert_allclose(np.asarray(sparse.csr_diagonal(csr)), np.arange(1, 8))
+    updated = sparse.csr_set_diagonal(csr, jnp.full((7,), 9.0))
+    np.testing.assert_allclose(np.asarray(sparse.csr_diagonal(updated)), 9.0)
+
+
+def test_csr_row_op(dense):
+    csr = CSR.from_dense(dense)
+    doubled = sparse.csr_row_op(csr, lambda rid, vals: vals * 2.0)
+    np.testing.assert_allclose(np.asarray(doubled.to_dense()), dense * 2)
+
+
+# -- preprocessing -----------------------------------------------------------
+
+def test_tfidf_matches_formula(rng):
+    counts = (rng.random((12, 20)) < 0.3) * rng.integers(1, 5, (12, 20))
+    counts = counts.astype(np.float32)
+    csr = CSR.from_dense(counts)
+    out = np.asarray(sparse.encode_tfidf(csr).to_dense())
+    df = np.count_nonzero(counts, axis=0)
+    idf = np.log1p(12 / (1.0 + df))
+    want = counts * idf[None, :]
+    np.testing.assert_allclose(out, want.astype(np.float32), rtol=1e-5, atol=1e-6)
+
+
+def test_bm25_basic_properties(rng):
+    counts = ((rng.random((10, 15)) < 0.4) * rng.integers(1, 6, (10, 15))).astype(np.float32)
+    csr = CSR.from_dense(counts)
+    out = np.asarray(sparse.encode_bm25(csr).to_dense())
+    assert out.shape == counts.shape
+    assert np.all((out != 0) == (counts != 0))
+    assert np.all(out[counts != 0] > 0)
+
+
+# -- CSR select_k ------------------------------------------------------------
+
+def test_csr_select_k(dense):
+    csr = CSR.from_dense(dense)
+    vals, cols = sparse.csr_select_k(csr, 3, select_min=True)
+    for r in range(dense.shape[0]):
+        nz_cols = np.nonzero(dense[r])[0]
+        nz_vals = dense[r, nz_cols]
+        order = np.argsort(nz_vals)[:3]
+        got_vals = np.asarray(vals[r])
+        finite = np.isfinite(got_vals)
+        np.testing.assert_allclose(got_vals[finite], np.sort(nz_vals)[: finite.sum()], rtol=1e-6)
+        got_cols = np.asarray(cols[r])[finite]
+        np.testing.assert_array_equal(np.sort(got_cols), np.sort(nz_cols[order[: finite.sum()]]))
