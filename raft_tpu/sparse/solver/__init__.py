@@ -1,0 +1,17 @@
+"""Sparse solvers — ``raft/sparse/solver`` parity (SURVEY.md §2.5):
+thick-restart Lanczos, randomized sparse SVD, Borůvka MST."""
+
+from .lanczos import LanczosConfig, eigsh, lanczos_compute_eigenpairs
+from .mst import MstResult, mst
+from .randomized_svd import SvdsConfig, randomized_svds, svds
+
+__all__ = [
+    "LanczosConfig",
+    "eigsh",
+    "lanczos_compute_eigenpairs",
+    "MstResult",
+    "mst",
+    "SvdsConfig",
+    "randomized_svds",
+    "svds",
+]

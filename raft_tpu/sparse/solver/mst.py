@@ -1,0 +1,135 @@
+"""Minimum spanning tree / forest — Borůvka.
+
+Parity with ``sparse/solver/mst.cuh:38`` ``mst()`` and the
+``mst_solver.cuh`` Borůvka solver class (kernels ``detail/mst_kernels.cuh``,
+``detail/mst_solver_inl.cuh``) — the basis of cuML's HDBSCAN/linkage.
+
+TPU redesign: the reference's per-vertex kernels (min-edge-per-supervertex,
+hooking, pointer-jumping) become whole-graph vectorized rounds:
+
+* min outgoing edge per component — ``segment_min`` over edge keys,
+* hooking + cycle break — pure index arithmetic,
+* pointer jumping to collapse label trees — ``log n`` gather rounds.
+
+Everything is fixed-shape; Borůvka needs at most ``ceil(log2 n)`` rounds.
+Ties are broken by (weight, edge id) like the reference's
+``alteration`` scheme, guaranteeing a unique MST even with equal weights.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.errors import expects
+from ..types import COO, CSR
+
+__all__ = ["MstResult", "mst"]
+
+
+class MstResult(NamedTuple):
+    """``Graph_COO`` output parity (``mst_solver.cuh``)."""
+
+    src: jax.Array      # [n-1] int32 (padded with -1 for forests)
+    dst: jax.Array      # [n-1]
+    weight: jax.Array   # [n-1]
+    n_edges: int        # valid prefix length
+    color: jax.Array    # [n] final component label per vertex
+
+
+def _pointer_jump(parent):
+    """Collapse label trees: parent = parent[parent] until fixpoint
+    (``detail/mst_utils.cuh`` pointer jumping; log2(n) unrolled rounds)."""
+    n = parent.shape[0]
+    rounds = max(1, int(np.ceil(np.log2(max(n, 2)))))
+    for _ in range(rounds):
+        parent = parent[parent]
+    return parent
+
+
+def mst(g: Union[COO, CSR]) -> MstResult:
+    """Minimum spanning forest of an undirected weighted graph.
+
+    Input: symmetric COO/CSR (both (i,j) and (j,i) present, as the reference
+    requires).  Returns up to ``n-1`` edges; for disconnected graphs the valid
+    prefix covers each component's tree and ``n_edges < n-1``.
+    """
+    if isinstance(g, CSR):
+        from ..convert import csr_to_coo
+
+        g = csr_to_coo(g)
+    n = g.shape[0]
+    expects(g.shape[0] == g.shape[1], "mst: graph must be square")
+    cap = g.capacity
+
+    src = g.rows
+    dst = g.cols
+    w = g.vals
+    valid_e = np.asarray(g.pad_mask())
+    eid = jnp.arange(cap, dtype=jnp.int32)
+
+    # order edges by (weight, id) for deterministic tie-breaks: rank array
+    order = jnp.argsort(jnp.where(jnp.asarray(valid_e), w, jnp.inf), stable=True)
+    rank_of = jnp.zeros((cap,), jnp.int32).at[order].set(eid)
+
+    color = jnp.arange(n, dtype=jnp.int32)
+    picked = jnp.zeros((cap,), bool)
+
+    rounds = max(1, int(np.ceil(np.log2(max(n, 2)))))
+    for _ in range(rounds):
+        csrc = color[jnp.clip(src, 0, n - 1)]
+        cdst = color[jnp.clip(dst, 0, n - 1)]
+        cross = jnp.asarray(valid_e) & (csrc != cdst)
+        # min outgoing edge per component, keyed by deterministic rank
+        key = jnp.where(cross, rank_of, cap)
+        best = jax.ops.segment_min(key, csrc, num_segments=n)  # [n] edge rank
+        has_out = best < cap
+        # translate rank back to edge id
+        edge_at_rank = jnp.zeros((cap,), jnp.int32).at[rank_of].set(eid)
+        best_eid = edge_at_rank[jnp.clip(best, 0, cap - 1)]
+        # hooking: component c hooks onto color of the other endpoint
+        to = jnp.where(
+            has_out,
+            jnp.where(color[jnp.clip(src[best_eid], 0, n - 1)] == jnp.arange(n),
+                      color[jnp.clip(dst[best_eid], 0, n - 1)],
+                      color[jnp.clip(src[best_eid], 0, n - 1)]),
+            jnp.arange(n, dtype=jnp.int32),
+        )
+        # cycle breaking: mutual hooks a<->b keep the smaller label as root
+        mutual = to[to] == jnp.arange(n)
+        parent = jnp.where(mutual & (jnp.arange(n) < to), jnp.arange(n), to)
+        # mark edges picked this round: one per hooking component that is not
+        # the surviving root of a mutual pair (avoids double-adding a<->b)
+        adds = has_out & ~(mutual & (jnp.arange(n) < to))
+        picked = picked.at[jnp.clip(best_eid, 0, cap - 1)].set(
+            picked[jnp.clip(best_eid, 0, cap - 1)] | adds
+        )
+        # compose: vertices relabel through their component's new root
+        color = _pointer_jump(parent)[color]
+
+    # compact picked edges (dedup (a,b)/(b,a): keep src<dst orientation once)
+    picked_np = np.asarray(picked)
+    src_np, dst_np, w_np = np.asarray(src), np.asarray(dst), np.asarray(w)
+    lo = np.minimum(src_np, dst_np)
+    hi = np.maximum(src_np, dst_np)
+    seen = {}
+    out = []
+    for e in np.nonzero(picked_np)[0]:
+        kkey = (int(lo[e]), int(hi[e]))
+        if kkey not in seen:
+            seen[kkey] = True
+            out.append(e)
+    out_src = np.full((max(n - 1, 1),), -1, np.int32)
+    out_dst = np.full((max(n - 1, 1),), -1, np.int32)
+    out_w = np.zeros((max(n - 1, 1),), np.asarray(w).dtype)
+    for i, e in enumerate(out[: n - 1]):
+        out_src[i] = src_np[e]
+        out_dst[i] = dst_np[e]
+        out_w[i] = w_np[e]
+    return MstResult(
+        jnp.asarray(out_src), jnp.asarray(out_dst), jnp.asarray(out_w),
+        len(out[: max(n - 1, 0)]), color,
+    )

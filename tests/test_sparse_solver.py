@@ -1,0 +1,161 @@
+"""Sparse solver tests — vs dense numpy references (the reference validates
+eigsh against cupyx.scipy, ``pylibraft/tests/test_sparse.py``; SURVEY.md §4)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.sparse import CSR, COO
+from raft_tpu.sparse.solver import eigsh, mst, svds
+
+
+def _sym_sparse(rng, n, density=0.2, shift=0.0):
+    d = rng.standard_normal((n, n)).astype(np.float32)
+    mask = rng.random((n, n)) < density
+    d = d * mask
+    d = (d + d.T) / 2
+    d = d + shift * np.eye(n, dtype=np.float32)
+    return d
+
+
+# -- Lanczos -----------------------------------------------------------------
+
+def test_eigsh_smallest(rng):
+    d = _sym_sparse(rng, 60, 0.3, shift=0.5)
+    csr = CSR.from_dense(d)
+    vals, vecs = eigsh(csr, k=4, which="SA", ncv=24, maxiter=600, tol=1e-6)
+    want = np.sort(np.linalg.eigvalsh(d.astype(np.float64)))[:4]
+    np.testing.assert_allclose(np.sort(np.asarray(vals)), want, rtol=2e-3, atol=2e-3)
+    # residual check ||A v - lambda v||
+    for i in range(4):
+        v = np.asarray(vecs[:, i])
+        lam = float(vals[i])
+        assert np.linalg.norm(d @ v - lam * v) < 5e-2
+
+
+def test_eigsh_largest(rng):
+    d = _sym_sparse(rng, 50, 0.3)
+    csr = CSR.from_dense(d)
+    vals, _ = eigsh(csr, k=3, which="LA", ncv=25, maxiter=500, tol=1e-6)
+    want = np.sort(np.linalg.eigvalsh(d.astype(np.float64)))[-3:]
+    np.testing.assert_allclose(np.sort(np.asarray(vals)), want, rtol=2e-3, atol=2e-3)
+
+
+def test_eigsh_laplacian_smallest_is_zero(rng):
+    # graph Laplacian: smallest eigenvalue must be ~0
+    from raft_tpu.sparse import compute_graph_laplacian
+
+    a = (rng.random((30, 30)) < 0.3)
+    a = np.triu(a, 1)
+    a = (a | a.T).astype(np.float32)
+    # make it connected
+    for i in range(29):
+        a[i, i + 1] = a[i + 1, i] = 1.0
+    lap = compute_graph_laplacian(CSR.from_dense(a))
+    vals, _ = eigsh(lap, k=2, which="SA", ncv=20, tol=1e-6)
+    assert abs(float(vals[0])) < 1e-2
+
+
+# -- randomized SVD ----------------------------------------------------------
+
+def test_svds_matches_dense(rng):
+    d = (rng.standard_normal((80, 40)) * (rng.random((80, 40)) < 0.3)).astype(np.float32)
+    csr = CSR.from_dense(d)
+    u, s, v = svds(csr, k=5, p=10, n_iters=6)
+    want = np.linalg.svd(d.astype(np.float64), compute_uv=False)[:5]
+    np.testing.assert_allclose(np.asarray(s), want, rtol=5e-3, atol=5e-3)
+    # reconstruction on the top-5 subspace
+    approx = np.asarray(u) @ np.diag(np.asarray(s)) @ np.asarray(v).T
+    best = None
+    uu, ss, vvt = np.linalg.svd(d.astype(np.float64))
+    best = (uu[:, :5] * ss[:5]) @ vvt[:5]
+    assert np.linalg.norm(approx - best) / max(np.linalg.norm(best), 1e-9) < 0.05
+
+
+def test_svds_orthonormal_factors(rng):
+    d = (rng.standard_normal((50, 30)) * (rng.random((50, 30)) < 0.4)).astype(np.float32)
+    u, s, v = svds(CSR.from_dense(d), k=4)
+    np.testing.assert_allclose(np.asarray(u.T @ u), np.eye(4), atol=1e-3)
+    np.testing.assert_allclose(np.asarray(v.T @ v), np.eye(4), atol=1e-3)
+    assert np.all(np.diff(np.asarray(s)) <= 1e-6)  # descending
+
+
+def test_svds_sign_deterministic(rng):
+    d = (rng.standard_normal((40, 25)) * (rng.random((40, 25)) < 0.4)).astype(np.float32)
+    u1, _, v1 = svds(CSR.from_dense(d), k=3, seed=1, n_iters=8)
+    u2, _, v2 = svds(CSR.from_dense(d), k=3, seed=2, n_iters=8)
+    # different sketches converge to the same vectors with the same signs
+    np.testing.assert_allclose(np.asarray(u1), np.asarray(u2), atol=2e-2)
+
+
+# -- MST ---------------------------------------------------------------------
+
+def _mst_weight_reference(n, edges):
+    """Kruskal on the host for ground truth."""
+    parent = list(range(n))
+
+    def find(x):
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    total, count = 0.0, 0
+    for w, a, b in sorted(edges):
+        ra, rb = find(a), find(b)
+        if ra != rb:
+            parent[ra] = rb
+            total += w
+            count += 1
+    return total, count
+
+
+def test_mst_path_graph():
+    # path 0-1-2-3 with known weights: MST = all edges
+    rows = [0, 1, 1, 2, 2, 3]
+    cols = [1, 0, 2, 1, 3, 2]
+    vals = [1.0, 1.0, 2.0, 2.0, 3.0, 3.0]
+    g = COO.from_arrays(rows, cols, vals, (4, 4))
+    result = mst(g)
+    assert result.n_edges == 3
+    assert float(jnp.sum(result.weight[: result.n_edges])) == 6.0
+    assert len(set(np.asarray(result.color).tolist())) == 1
+
+
+def test_mst_random_graph_weight(rng):
+    n = 40
+    d = rng.random((n, n)).astype(np.float32)
+    mask = rng.random((n, n)) < 0.15
+    d = d * mask
+    d = np.triu(d, 1)
+    for i in range(n - 1):  # ensure connected
+        if d[i, i + 1] == 0:
+            d[i, i + 1] = rng.random() + 0.5
+    sym = d + d.T
+    g = COO.from_dense(sym)
+    result = mst(g)
+    edges = [(float(sym[i, j]), i, j) for i in range(n) for j in range(i + 1, n)
+             if sym[i, j] != 0]
+    want_w, want_n = _mst_weight_reference(n, edges)
+    assert result.n_edges == want_n == n - 1
+    got_w = float(jnp.sum(result.weight[: result.n_edges]))
+    np.testing.assert_allclose(got_w, want_w, rtol=1e-5)
+
+
+def test_mst_forest_disconnected():
+    # two disjoint triangles -> forest with 4 edges, 2 colors
+    def tri(base):
+        r, c, v = [], [], []
+        for (a, b, w) in [(0, 1, 1.0), (1, 2, 2.0), (0, 2, 3.0)]:
+            r += [base + a, base + b]
+            c += [base + b, base + a]
+            v += [w, w]
+        return r, c, v
+
+    r1, c1, v1 = tri(0)
+    r2, c2, v2 = tri(3)
+    g = COO.from_arrays(r1 + r2, c1 + c2, v1 + v2, (6, 6))
+    result = mst(g)
+    assert result.n_edges == 4
+    assert float(jnp.sum(result.weight[: result.n_edges])) == 6.0
+    assert len(set(np.asarray(result.color).tolist())) == 2
