@@ -1,0 +1,15 @@
+"""Label utilities — ``raft/label`` parity (SURVEY.md §2.8)."""
+
+from .labels import (
+    get_ovr_labels,
+    get_unique_labels,
+    make_monotonic,
+    merge_labels,
+)
+
+__all__ = [
+    "get_unique_labels",
+    "get_ovr_labels",
+    "make_monotonic",
+    "merge_labels",
+]

@@ -1,0 +1,90 @@
+"""Label utilities.
+
+Parity: ``label/classlabels.cuh`` (``getUniquelabels:31``, ``getOvrlabels:55``,
+``make_monotonic:81``) and ``label/merge_labels.cuh:47`` (iterative-hooking
+union of two labellings — the CUDA kernel loop becomes pointer-jumping gathers).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["get_unique_labels", "get_ovr_labels", "make_monotonic", "merge_labels"]
+
+
+def get_unique_labels(y) -> jax.Array:
+    """Sorted unique labels (``getUniquelabels:31``).  Host-eager — the
+    reference also returns a host count; output size is data-dependent."""
+    return jnp.asarray(np.unique(np.asarray(y)))
+
+
+def get_ovr_labels(y, y_unique, idx: int, dtype=None) -> jax.Array:
+    """One-vs-rest ±1 labels (``getOvrlabels:55``):
+    out = (y == y_unique[idx]) ? +1 : -1."""
+    y = jnp.asarray(y)
+    target = jnp.asarray(y_unique)[idx]
+    out = jnp.where(y == target, 1, -1)
+    return out.astype(dtype or y.dtype)
+
+
+def make_monotonic(
+    y,
+    *,
+    filter_op: Optional[Callable] = None,
+    zero_based: bool = True,
+) -> jax.Array:
+    """Map labels onto a monotonically increasing set (``make_monotonic:81``).
+
+    ``filter_op(label) -> bool`` excludes labels from remapping (they pass
+    through unchanged), matching the reference's Lambda filter.
+    ``zero_based=False`` starts at 1 like the reference's default.
+    """
+    y = jnp.asarray(y)
+    yn = np.asarray(y)
+    if filter_op is not None:
+        keep = np.asarray([bool(filter_op(v)) for v in yn.tolist()])
+    else:
+        keep = np.ones(yn.shape, bool)
+    uniq = np.unique(yn[keep])
+    base = 0 if zero_based else 1
+    lut = {v: i + base for i, v in enumerate(uniq.tolist())}
+    out = np.asarray([lut[v] if k else v for v, k in zip(yn.tolist(), keep.tolist())])
+    return jnp.asarray(out, y.dtype)
+
+
+def merge_labels(labels_a, labels_b, mask) -> jax.Array:
+    """Merge two labellings by connected components (``merge_labels.cuh:47``).
+
+    Points where ``mask`` is true act as "core" points: if a core point has
+    label i in A and j in B, groups i and j are merged.  Non-core points keep
+    their A-label unless their group was merged.  Labels follow the
+    reference's convention: the representative is the *minimum* label of the
+    merged group.  Iterative hooking + pointer jumping, log rounds.
+    """
+    a = jnp.asarray(labels_a, jnp.int32)
+    b = jnp.asarray(labels_b, jnp.int32)
+    mask = jnp.asarray(mask, bool)
+    n = a.shape[0]
+    # union-find domain: label values (bounded by n+1 per the contract)
+    m = int(max(int(jnp.max(a)), int(jnp.max(b))) + 1)
+    parent = jnp.arange(m, dtype=jnp.int32)
+
+    rounds = max(1, int(np.ceil(np.log2(max(m, 2)))) + 1)
+    for _ in range(rounds):
+        # hook: for each core point, link max(parent of a, parent of b) to min
+        ra = parent[a]
+        rb = parent[b]
+        lo = jnp.minimum(ra, rb)
+        hi = jnp.maximum(ra, rb)
+        upd = jnp.where(mask, lo, parent[jnp.clip(hi, 0, m - 1)])
+        parent = parent.at[jnp.clip(hi, 0, m - 1)].min(
+            jnp.where(mask, upd, m)
+        )
+        # pointer jumping
+        for _ in range(rounds):
+            parent = parent[parent]
+    return parent[a]

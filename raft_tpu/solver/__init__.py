@@ -1,0 +1,5 @@
+"""Combinatorial solvers — ``raft/solver`` parity (SURVEY.md §2.8)."""
+
+from .lap import LinearAssignmentProblem, lap_solve
+
+__all__ = ["LinearAssignmentProblem", "lap_solve"]

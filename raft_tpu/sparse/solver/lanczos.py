@@ -74,18 +74,29 @@ def _select_ritz(evals, which: str, k: int):
     return jnp.sort(idx)  # keep ascending position order like the reference
 
 
-def _lanczos_extend(matvec, V, alpha, beta, u, start: int, ncv: int):
+def _lanczos_extend(matvec, V, alpha, beta, u, start: int, ncv: int, key=None):
     """Tridiagonalize from index ``start`` to ``ncv`` (``lanczos_aux:248``).
 
     V: [ncv, n] basis (rows < start valid); u: current residual vector.
     Full re-orthogonalization per step: two skinny MXU matmuls.
+
+    Breakdown handling (beta → 0: Krylov space exhausted, common for graph
+    Laplacians with few distinct eigenvalues): the residual is replaced by a
+    fresh random vector orthogonalized against the basis — the standard
+    deflation-restart, and the clamp guards of ``lanczos.cuh:386-390`` are
+    its f32 analog.
     """
     n = V.shape[1]
+    if key is None:
+        key = jax.random.PRNGKey(0)
     for i in range(start, ncv):
         unrm = jnp.linalg.norm(u)
-        # kernel_clamp_down_vector/_clamp guards (lanczos.cuh:386-390)
-        safe = jnp.maximum(unrm, 1e-12)
-        vi = u / safe
+        breakdown = unrm < 1e-5
+        repl = jax.random.normal(jax.random.fold_in(key, i), (n,), V.dtype)
+        repl = repl - V.T @ (V @ repl)
+        repl = repl - V.T @ (V @ repl)
+        repl = repl / jnp.maximum(jnp.linalg.norm(repl), 1e-12)
+        vi = jnp.where(breakdown, repl, u / jnp.maximum(unrm, 1e-12))
         V = V.at[i].set(vi)
         w = matvec(vi)
         a_i = jnp.dot(vi, w)
@@ -143,17 +154,17 @@ def lanczos_compute_eigenpairs(
     v0 = jnp.asarray(v0, dtype)
 
     @jax.jit
-    def first_cycle(u0):
+    def first_cycle(u0, key):
         V = jnp.zeros((ncv, n), dtype)
         alpha = jnp.zeros((ncv,), dtype)
         beta = jnp.zeros((ncv,), dtype)
-        V, alpha, beta, u = _lanczos_extend(matvec, V, alpha, beta, u0, 0, ncv)
+        V, alpha, beta, u = _lanczos_extend(matvec, V, alpha, beta, u0, 0, ncv, key)
         t = _build_t(alpha, beta, None, 0, ncv)
         evals, evecs = jnp.linalg.eigh(t)
         return V, alpha, beta, u, evals, evecs
 
     @jax.jit
-    def restart_cycle(V, ritz_vals, ritz_vecs_small, beta_last, u):
+    def restart_cycle(V, ritz_vals, ritz_vecs_small, beta_last, u, key):
         # Lock k Ritz vectors: V[:k] = (V^T @ s)^T  (gemm at lanczos.cuh:505)
         locked = (V.T @ ritz_vecs_small).T  # [k, n]
         Vn = jnp.zeros((ncv, n), dtype).at[:k].set(locked)
@@ -163,13 +174,15 @@ def lanczos_compute_eigenpairs(
         uu = Vn[:k] @ u
         u = u - Vn[:k].T @ uu
         beta = jnp.zeros((ncv,), dtype)
-        Vn, alpha, beta, u = _lanczos_extend(matvec, Vn, alpha, beta, u, k, ncv)
+        Vn, alpha, beta, u = _lanczos_extend(matvec, Vn, alpha, beta, u, k, ncv, key)
         t = _build_t(alpha, beta, beta_k, k, ncv)
         evals, evecs = jnp.linalg.eigh(t)
         return Vn, alpha, beta, u, evals, evecs
 
-    V, alpha, beta, u, evals, evecs = first_cycle(v0)
+    key = jax.random.PRNGKey(config.seed + 1)
+    V, alpha, beta, u, evals, evecs = first_cycle(v0, key)
     iters = ncv
+    cycle = 0
     while True:
         sel = _select_ritz(evals, config.which, k)
         ritz_vals = evals[sel]
@@ -177,8 +190,9 @@ def lanczos_compute_eigenpairs(
         res = float(jnp.linalg.norm(beta[ncv - 1] * s[ncv - 1, :]))
         if res <= config.tolerance or iters >= config.max_iterations:
             break
+        cycle += 1
         V, alpha, beta, u, evals, evecs = restart_cycle(
-            V, ritz_vals, s, beta[ncv - 1], u
+            V, ritz_vals, s, beta[ncv - 1], u, jax.random.fold_in(key, cycle)
         )
         iters += ncv - k
 

@@ -104,9 +104,9 @@ def mst(g: Union[COO, CSR]) -> MstResult:
         # mark edges picked this round: one per hooking component that is not
         # the surviving root of a mutual pair (avoids double-adding a<->b)
         adds = has_out & ~(mutual & (jnp.arange(n) < to))
-        picked = picked.at[jnp.clip(best_eid, 0, cap - 1)].set(
-            picked[jnp.clip(best_eid, 0, cap - 1)] | adds
-        )
+        # sentinel index `cap` drops non-adding components (a stale-read
+        # write could otherwise clobber a concurrent True)
+        picked = picked.at[jnp.where(adds, best_eid, cap)].set(True, mode="drop")
         # compose: vertices relabel through their component's new root
         color = _pointer_jump(parent)[color]
 

@@ -1,0 +1,5 @@
+"""Spectral graph analysis — ``raft/spectral`` parity (SURVEY.md §2.8)."""
+
+from .analysis import analyze_modularity, analyze_partition, spectral_partition
+
+__all__ = ["analyze_partition", "analyze_modularity", "spectral_partition"]

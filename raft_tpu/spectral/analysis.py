@@ -1,0 +1,95 @@
+"""Spectral partition/modularity analysis.
+
+Parity: ``spectral/partition.cuh:38`` ``analyzePartition``,
+``spectral/modularity_maximization.cuh:35`` ``analyzeModularity``
+(impl ``spectral/detail/partition.hpp:52``,
+``detail/modularity_maximization.hpp:48``; indicator construction
+``detail/spectral_util.cuh:127``).
+
+The reference loops clusters, building one indicator vector at a time and
+hitting cuSPARSE SpMV per cluster.  The TPU formulation batches all clusters
+at once: the one-hot membership matrix ``X [n, k]`` turns the per-cluster
+quadratic forms into two SpMM + reductions on the MXU.
+
+The full spectral *clustering* driver was removed from the reference with the
+cuVS migration (SURVEY.md §2.8 note); :func:`spectral_partition` restores the
+pre-migration capability (Laplacian eigenvectors → kmeans) from our own
+Lanczos + kmeans pieces.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..sparse.linalg import spmm, spmv
+from ..sparse.types import CSR
+
+__all__ = ["analyze_partition", "analyze_modularity", "spectral_partition"]
+
+
+def _one_hot(labels, k: int, dtype):
+    return (labels[:, None] == jnp.arange(k)[None, :]).astype(dtype)
+
+
+def analyze_partition(csr: CSR, n_clusters: int, labels) -> Tuple[jax.Array, jax.Array]:
+    """Edge cut and balanced-cut cost of a partition
+    (``detail/partition.hpp:78-91``: cost += xᵀLx/|c|, edgeCut += xᵀLx/2).
+    """
+    labels = jnp.asarray(labels)
+    x = _one_hot(labels, n_clusters, csr.data.dtype)  # [n, k]
+    deg = spmv(csr, jnp.ones((csr.n_cols,), csr.data.dtype))
+    ax = spmm(csr, x)  # A X
+    # xᶜᵀ L xᶜ = Σ_i∈c deg_i − xᶜᵀ A xᶜ
+    quad = jnp.sum(x * (deg[:, None] - ax), axis=0)  # [k]
+    sizes = jnp.sum(x, axis=0)
+    safe = jnp.maximum(sizes, 1.0)
+    nonempty = sizes > 0
+    cost = jnp.sum(jnp.where(nonempty, quad / safe, 0.0))
+    edge_cut = jnp.sum(jnp.where(nonempty, quad, 0.0)) / 2.0
+    return edge_cut, cost
+
+
+def analyze_modularity(csr: CSR, n_clusters: int, labels) -> jax.Array:
+    """Newman modularity of a clustering
+    (``detail/modularity_maximization.hpp:70-83``:
+    Q = Σ_c xᶜᵀBxᶜ / ‖d‖₁ with B = A − d dᵀ/‖d‖₁)."""
+    labels = jnp.asarray(labels)
+    x = _one_hot(labels, n_clusters, csr.data.dtype)
+    deg = spmv(csr, jnp.ones((csr.n_cols,), csr.data.dtype))
+    two_m = jnp.sum(deg)  # ‖d‖₁ (2m for unweighted graphs)
+    ax = spmm(csr, x)
+    quad_a = jnp.sum(x * ax, axis=0)              # xᶜᵀ A xᶜ
+    dx = x.T @ deg                                # [k] Σ_i∈c d_i
+    quad_b = quad_a - dx * dx / jnp.maximum(two_m, 1e-12)
+    return jnp.sum(quad_b) / jnp.maximum(two_m, 1e-12)
+
+
+def spectral_partition(
+    csr: CSR,
+    n_clusters: int,
+    *,
+    n_eig: Optional[int] = None,
+    seed: int = 42,
+    kmeans_max_iter: int = 100,
+):
+    """Laplacian spectral clustering: smallest-eigenvector embedding + kmeans.
+
+    Restores the pre-cuVS-migration driver (partition.cuh's removed half)
+    from in-tree pieces: :func:`~raft_tpu.sparse.solver.eigsh` on L and
+    :func:`~raft_tpu.cluster.kmeans_fit_predict`.
+    Returns ``(labels, eigenvalues, embedding)``.
+    """
+    from ..cluster.kmeans import KMeansParams, kmeans_fit_predict
+    from ..sparse.linalg import compute_graph_laplacian
+    from ..sparse.solver import eigsh
+
+    k = n_eig or n_clusters
+    lap = compute_graph_laplacian(csr)
+    vals, vecs = eigsh(lap, k=k, which="SA", tol=1e-6, seed=seed)
+    params = KMeansParams(n_clusters=n_clusters, max_iter=kmeans_max_iter,
+                          seed=seed)
+    _, labels, _, _ = kmeans_fit_predict(vecs, params)
+    return labels, vals, vecs

@@ -1,0 +1,140 @@
+"""Tests for LAP (vs scipy linear_sum_assignment), spectral analysis (vs
+naive formulas), and label utils — reference suites ``cpp/tests/lap/lap.cu``,
+``cpp/tests/sparse/spectral_matrix.cu``, ``cpp/tests/label/``."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.label import get_ovr_labels, get_unique_labels, make_monotonic, merge_labels
+from raft_tpu.solver import LinearAssignmentProblem, lap_solve
+from raft_tpu.sparse import CSR
+from raft_tpu.spectral import analyze_modularity, analyze_partition, spectral_partition
+
+try:
+    from scipy.optimize import linear_sum_assignment
+
+    HAVE_SCIPY = True
+except ImportError:
+    HAVE_SCIPY = False
+
+
+# -- LAP ---------------------------------------------------------------------
+
+@pytest.mark.skipif(not HAVE_SCIPY, reason="scipy unavailable")
+@pytest.mark.parametrize("n", [4, 16, 32])
+def test_lap_matches_scipy(rng, n):
+    cost = rng.random((n, n)).astype(np.float32)
+    row, col = lap_solve(cost, epsilon=1e-5)
+    ri, ci = linear_sum_assignment(cost)
+    want = cost[ri, ci].sum()
+    got = cost[np.arange(n), np.asarray(row)].sum()
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+    # valid permutation
+    assert sorted(np.asarray(row).tolist()) == list(range(n))
+    # col assignment is the inverse permutation
+    np.testing.assert_array_equal(np.asarray(col)[np.asarray(row)], np.arange(n))
+
+
+@pytest.mark.skipif(not HAVE_SCIPY, reason="scipy unavailable")
+def test_lap_batched(rng):
+    n, b = 12, 5
+    cost = rng.random((b, n, n)).astype(np.float32)
+    lap = LinearAssignmentProblem(n, b, epsilon=1e-5)
+    row, col = lap.solve(cost)
+    prim = np.asarray(lap.get_primal_objective())
+    for i in range(b):
+        ri, ci = linear_sum_assignment(cost[i])
+        np.testing.assert_allclose(prim[i], cost[i][ri, ci].sum(), rtol=1e-4)
+
+
+def test_lap_integer_costs():
+    cost = np.asarray([[4, 1, 3], [2, 0, 5], [3, 2, 2]], np.float32)
+    row, _ = lap_solve(cost)
+    got = cost[np.arange(3), np.asarray(row)].sum()
+    assert got == 5.0  # known optimum
+
+
+# -- spectral analysis -------------------------------------------------------
+
+def _two_cliques(n1=5, n2=5, bridges=1):
+    n = n1 + n2
+    a = np.zeros((n, n), np.float32)
+    a[:n1, :n1] = 1
+    a[n1:, n1:] = 1
+    np.fill_diagonal(a, 0)
+    for i in range(bridges):
+        a[i, n1 + i] = a[n1 + i, i] = 1
+    return a
+
+
+def test_analyze_partition_two_cliques():
+    a = _two_cliques()
+    csr = CSR.from_dense(a)
+    labels = np.r_[np.zeros(5, np.int32), np.ones(5, np.int32)]
+    edge_cut, cost = analyze_partition(csr, 2, jnp.asarray(labels))
+    assert float(edge_cut) == 1.0  # single bridge
+    np.testing.assert_allclose(float(cost), 1 / 5 + 1 / 5, rtol=1e-5)
+
+
+def test_analyze_modularity_matches_naive(rng):
+    a = _two_cliques(6, 6, 2)
+    csr = CSR.from_dense(a)
+    labels = np.r_[np.zeros(6, np.int32), np.ones(6, np.int32)]
+    got = float(analyze_modularity(csr, 2, jnp.asarray(labels)))
+    # naive Newman modularity
+    deg = a.sum(1)
+    two_m = deg.sum()
+    q = 0.0
+    for c in (0, 1):
+        idx = labels == c
+        q += a[np.ix_(idx, idx)].sum() - deg[idx].sum() ** 2 / two_m
+    q /= two_m
+    np.testing.assert_allclose(got, q, rtol=1e-5)
+    # good partition → positive modularity; random labels → lower
+    bad = float(analyze_modularity(csr, 2, jnp.asarray(labels[::-1].copy() * 0)))
+    assert got > bad
+
+
+def test_spectral_partition_recovers_cliques():
+    a = _two_cliques(8, 8, 1)
+    labels, vals, _ = spectral_partition(CSR.from_dense(a), 2, seed=0)
+    labels = np.asarray(labels)
+    # the two cliques must land in different clusters
+    assert len(set(labels[:8].tolist())) == 1
+    assert len(set(labels[8:].tolist())) == 1
+    assert labels[0] != labels[8]
+    assert abs(float(vals[0])) < 1e-2  # lambda_0(L) = 0
+
+
+# -- label utils -------------------------------------------------------------
+
+def test_unique_and_ovr():
+    y = jnp.asarray([3.0, 1.0, 3.0, 9.0, 1.0])
+    u = get_unique_labels(y)
+    np.testing.assert_array_equal(np.asarray(u), [1.0, 3.0, 9.0])
+    ovr = get_ovr_labels(y, u, 1)
+    np.testing.assert_array_equal(np.asarray(ovr), [1, -1, 1, -1, -1])
+
+
+def test_make_monotonic():
+    y = jnp.asarray([10, 20, 10, 40], jnp.int32)
+    out = make_monotonic(y)
+    np.testing.assert_array_equal(np.asarray(out), [0, 1, 0, 2])
+    out1 = make_monotonic(y, zero_based=False)
+    np.testing.assert_array_equal(np.asarray(out1), [1, 2, 1, 3])
+
+
+def test_make_monotonic_filtered():
+    y = jnp.asarray([7, 5, 7, -1, 5], jnp.int32)
+    out = make_monotonic(y, filter_op=lambda v: v >= 0)
+    np.testing.assert_array_equal(np.asarray(out), [1, 0, 1, -1, 0])
+
+
+def test_merge_labels_components():
+    # A: {0,1} -> 1, {2,3} -> 3 ; B: {1,2} -> 2 (core) links the groups
+    a = jnp.asarray([1, 1, 3, 3], jnp.int32)
+    b = jnp.asarray([9, 2, 2, 8], jnp.int32)
+    mask = jnp.asarray([False, True, True, False])
+    out = np.asarray(merge_labels(a, b, mask))
+    assert out[0] == out[1] == out[2] == out[3]  # all merged through B's core
