@@ -1,0 +1,321 @@
+"""IVF-Flat — inverted-file index with flat (uncompressed) lists.
+
+No in-tree CUDA ancestor (cuVS migration, SURVEY.md scope note); designed
+from the north-star capability list (``BASELINE.json`` configs: ivf_flat +
+kmeans_balanced on SIFT-1M) and the TPU-KNN paper (PAPERS.md).
+
+TPU-first design:
+* **Coarse quantizer** = :func:`raft_tpu.cluster.kmeans_balanced_fit` — the
+  balanced variant exists precisely because dense padded lists need a hard
+  size bound (list capacity is a static shape).
+* **List layout**: one dense ``[n_lists, cap, d]`` slab + ``[n_lists, cap]``
+  source ids, pad entries masked by per-list counts.  Gathers of whole lists
+  are contiguous HBM reads; no pointer-chasing.
+* **Search**: query→centroid distances on the MXU, ``top_k`` probe pick,
+  then one scan iteration per probe rank: gather the probed list slab,
+  batched dot on the MXU, mask pads, merge into the running top-k via
+  ``select_k`` (same merge primitive as brute force).  Everything
+  static-shape, jit-compiled once per (nq, k, n_probes) config.
+* **Sharded variant**: lists are partitioned round-robin over the mesh axis;
+  every shard searches its local lists with the same program and the
+  per-shard candidates merge with one ``all_gather`` + ``select_k`` -- the
+  index-shard MNMG model of SURVEY.md §5.7 on ICI instead of NCCL.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..cluster.kmeans import KMeansParams, capped_assign, kmeans_balanced_fit
+from ..core.array import wrap_array
+from ..core.errors import expects
+from ..distance.pairwise import sq_l2
+from .brute_force import tile_knn_merge
+
+__all__ = [
+    "IvfFlatIndexParams",
+    "IvfFlatSearchParams",
+    "IvfFlatIndex",
+    "build",
+    "search",
+    "extend",
+    "build_sharded",
+    "search_sharded",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class IvfFlatIndexParams:
+    """Build configuration (per-call parameter struct idiom, SURVEY.md §5.6b)."""
+
+    n_lists: int = 1024
+    metric: str = "sqeuclidean"  # sqeuclidean | euclidean | inner_product
+    kmeans_n_iters: int = 20
+    kmeans_trainset_fraction: float = 0.1
+    list_cap_ratio: float = 2.0  # capacity = ratio * n / n_lists
+    seed: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class IvfFlatSearchParams:
+    n_probes: int = 32
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class IvfFlatIndex:
+    centroids: jax.Array   # [L, d]
+    data: jax.Array        # [L, cap, d]
+    ids: jax.Array         # [L, cap] int32, -1 pad
+    counts: jax.Array      # [L] int32
+    norms: jax.Array       # [L, cap] f32 squared L2 of stored vectors
+    metric: str = dataclasses.field(metadata=dict(static=True))
+
+    @property
+    def n_lists(self) -> int:
+        return int(self.data.shape[0])
+
+    @property
+    def list_cap(self) -> int:
+        return int(self.data.shape[1])
+
+    @property
+    def dim(self) -> int:
+        return int(self.data.shape[2])
+
+    @property
+    def size(self) -> int:
+        return int(jnp.sum(self.counts))
+
+
+def _pack_lists(dataset: np.ndarray, ids: np.ndarray, labels: np.ndarray,
+                n_lists: int, cap: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Scatter rows into the dense padded list slab (host-side build step)."""
+    n, d = dataset.shape
+    data = np.zeros((n_lists, cap, d), dataset.dtype)
+    out_ids = np.full((n_lists, cap), -1, np.int32)
+    # vectorized scatter: sort by list, position = rank within the list
+    keep = labels >= 0
+    order = np.argsort(labels[keep] if keep.all() else
+                       np.where(keep, labels, n_lists), kind="stable")
+    order = order[: int(keep.sum())]
+    sl = labels[order]
+    counts = np.bincount(sl, minlength=n_lists).astype(np.int32)
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    pos = np.arange(order.shape[0]) - starts[sl]
+    ok = pos < cap  # capped_assign guarantees this; belt and braces
+    data[sl[ok], pos[ok]] = dataset[order[ok]]
+    out_ids[sl[ok], pos[ok]] = ids[order[ok]]
+    counts = np.minimum(counts, cap)
+    return data, out_ids, counts
+
+
+def build(dataset, params: Optional[IvfFlatIndexParams] = None, *,
+          source_ids=None, res=None) -> IvfFlatIndex:
+    """Train the coarse quantizer and pack inverted lists."""
+    p = params or IvfFlatIndexParams()
+    x = wrap_array(dataset, ndim=2, name="dataset")
+    n, d = x.shape
+    expects(p.n_lists >= 1 and p.n_lists <= n, "n_lists out of range")
+    cap = max(1, int(np.ceil(p.list_cap_ratio * n / p.n_lists)))
+
+    # 1. train balanced kmeans on a subsample (trainset_fraction idiom)
+    n_train = max(p.n_lists * 4, int(n * p.kmeans_trainset_fraction))
+    n_train = min(n, n_train)
+    key = jax.random.PRNGKey(p.seed)
+    sel = (jax.random.permutation(key, n)[:n_train] if n_train < n
+           else jnp.arange(n))
+    kp = KMeansParams(n_clusters=p.n_lists, max_iter=p.kmeans_n_iters,
+                      seed=p.seed)
+    centroids, _, _ = kmeans_balanced_fit(x[sel], kp)
+
+    # 2. capacity-constrained assignment of the full dataset
+    labels, _ = capped_assign(x, centroids, cap)
+
+    # 3. pack lists (host scatter — build is host-driven like the reference's)
+    ids = (np.asarray(source_ids, np.int32) if source_ids is not None
+           else np.arange(n, dtype=np.int32))
+    data, out_ids, counts = _pack_lists(np.asarray(x), ids,
+                                        np.asarray(labels), p.n_lists, cap)
+    data_j = jnp.asarray(data)
+    norms = jnp.sum(data_j.astype(jnp.float32) ** 2, axis=2)
+    return IvfFlatIndex(centroids, data_j, jnp.asarray(out_ids),
+                        jnp.asarray(counts), norms, p.metric)
+
+
+def extend(index: IvfFlatIndex, new_vectors, new_ids=None) -> IvfFlatIndex:
+    """Append vectors to existing lists (host-eager, like cuVS extend).
+
+    The list slab is a static shape, so capacity grows when the new rows
+    overflow it (rebuild-the-slab, the padded-layout price of extend).
+    """
+    x = np.asarray(wrap_array(new_vectors, ndim=2))
+    ids = (np.asarray(new_ids, np.int32) if new_ids is not None
+           else np.arange(index.size, index.size + x.shape[0], dtype=np.int32))
+    labels = np.asarray(jnp.argmin(sq_l2(jnp.asarray(x), index.centroids), axis=1))
+    old_counts = np.asarray(index.counts)
+    added = np.bincount(labels, minlength=index.n_lists)
+    new_cap = max(index.list_cap, int((old_counts + added).max()))
+
+    n_lists, d = index.n_lists, index.dim
+    data = np.zeros((n_lists, new_cap, d), np.asarray(index.data).dtype)
+    out_ids = np.full((n_lists, new_cap), -1, np.int32)
+    data[:, : index.list_cap] = np.asarray(index.data)
+    out_ids[:, : index.list_cap] = np.asarray(index.ids)
+
+    order = np.argsort(labels, kind="stable")
+    sl = labels[order]
+    starts = np.concatenate([[0], np.cumsum(added)[:-1]])
+    pos = old_counts[sl] + (np.arange(order.shape[0]) - starts[sl])
+    data[sl, pos] = x[order]
+    out_ids[sl, pos] = ids[order]
+    counts = (old_counts + added).astype(np.int32)
+
+    data_j = jnp.asarray(data)
+    norms = jnp.sum(data_j.astype(jnp.float32) ** 2, axis=2)
+    return IvfFlatIndex(index.centroids, data_j, jnp.asarray(out_ids),
+                        jnp.asarray(counts), norms, index.metric)
+
+
+def _probe_scan(q, qn, data, ids, counts, norms, probes, k: int, metric: str):
+    """Scan probe ranks, merging each probed list into the running top-k.
+
+    q: [nq, d]; probes: [nq, P].  One iteration gathers the p-th probed list
+    of every query ([nq, cap, d] slab) and computes the distance block with a
+    batched MXU dot.
+    """
+    nq = q.shape[0]
+    cap = data.shape[1]
+    n_probes = probes.shape[1]
+
+    def step(carry, p):
+        best_val, best_idx = carry
+        lists = probes[:, p]                      # [nq]
+        vecs = data[lists]                        # [nq, cap, d]
+        vids = ids[lists]                         # [nq, cap]
+        dots = jnp.einsum(
+            "qcd,qd->qc", vecs, q,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        if metric == "inner_product":
+            dist = -dots
+        else:  # sqeuclidean / euclidean rank by squared L2
+            dist = norms[lists] - 2.0 * dots + qn[:, None]
+            dist = jnp.maximum(dist, 0.0)
+        valid = jnp.arange(cap)[None, :] < counts[lists][:, None]
+        dist = jnp.where(valid & (vids >= 0), dist, jnp.inf)
+        return tile_knn_merge(best_val, best_idx, dist, vids, k), None
+
+    init = (jnp.full((nq, k), jnp.inf, jnp.float32),
+            jnp.full((nq, k), -1, jnp.int32))
+    (bv, bi), _ = jax.lax.scan(step, init, jnp.arange(n_probes))
+    return bv, bi
+
+
+@partial(jax.jit, static_argnames=("k", "n_probes", "metric"))
+def _search_impl(centroids, data, ids, counts, norms, q, k: int,
+                 n_probes: int, metric: str):
+    qf = q.astype(jnp.float32)
+    qn = jnp.sum(qf * qf, axis=1)
+    cd = sq_l2(q, centroids)                      # [nq, L] MXU block
+    _, probes = jax.lax.top_k(-cd, n_probes)      # nearest lists
+    bv, bi = _probe_scan(q, qn, data, ids, counts, norms, probes, k, metric)
+    if metric == "euclidean":
+        bv = jnp.sqrt(jnp.maximum(bv, 0.0))
+    elif metric == "inner_product":
+        bv = -bv
+    return bv, bi
+
+
+def search(index: IvfFlatIndex, queries, k: int,
+           params: Optional[IvfFlatSearchParams] = None, *, res=None
+           ) -> Tuple[jax.Array, jax.Array]:
+    """Approximate kNN: returns ``(distances, ids)`` of (nq, k), best first."""
+    p = params or IvfFlatSearchParams()
+    q = wrap_array(queries, ndim=2, name="queries")
+    expects(q.shape[1] == index.dim, "query dim mismatch")
+    n_probes = min(p.n_probes, index.n_lists)
+    return _search_impl(index.centroids, index.data, index.ids, index.counts,
+                        index.norms, q, int(k), int(n_probes), index.metric)
+
+
+# ---------------------------------------------------------------------------
+# Sharded (multi-chip) variant: lists partitioned over the mesh axis.
+# ---------------------------------------------------------------------------
+
+
+def build_sharded(dataset, mesh: Mesh, params: Optional[IvfFlatIndexParams] = None,
+                  *, axis: str = "shard") -> IvfFlatIndex:
+    """Build with ``n_lists`` padded to the axis size and the list slabs laid
+    out shard-major so device d owns lists [d*L/n, (d+1)*L/n)."""
+    p = params or IvfFlatIndexParams()
+    n_dev = int(mesh.shape[axis])
+    n_lists = ((p.n_lists + n_dev - 1) // n_dev) * n_dev
+    p = dataclasses.replace(p, n_lists=n_lists)
+    index = build(dataset, p)
+    sharding = jax.sharding.NamedSharding(mesh, P(axis))
+    return IvfFlatIndex(
+        jax.device_put(index.centroids, sharding),
+        jax.device_put(index.data, sharding),
+        jax.device_put(index.ids, sharding),
+        jax.device_put(index.counts, sharding),
+        jax.device_put(index.norms, sharding),
+        index.metric,
+    )
+
+
+@partial(jax.jit, static_argnames=("k", "n_probes", "metric", "axis", "mesh"))
+def _search_sharded_impl(mesh, axis, centroids, data, ids, counts, norms, q,
+                         k: int, n_probes: int, metric: str):
+    def local(centroids_l, data_l, ids_l, counts_l, norms_l, q_l):
+        bv, bi = _search_impl(centroids_l, data_l, ids_l, counts_l, norms_l,
+                              q_l, k, n_probes, metric)
+        # candidates from all shards → final top-k everywhere
+        if metric == "inner_product":
+            bv = -bv  # back to min-selectable
+        av = jax.lax.all_gather(bv, axis, tiled=False)  # [S, nq, k]
+        ai = jax.lax.all_gather(bi, axis, tiled=False)
+        av = jnp.moveaxis(av, 0, 1).reshape(q_l.shape[0], -1)
+        ai = jnp.moveaxis(ai, 0, 1).reshape(q_l.shape[0], -1)
+        from ..matrix.select_k import select_k
+
+        fv, fi = select_k(av, k, in_idx=ai, select_min=True)
+        if metric == "inner_product":
+            fv = -fv
+        return fv, fi
+
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )(centroids, data, ids, counts, norms, q)
+
+
+def search_sharded(index: IvfFlatIndex, queries, k: int,
+                   params: Optional[IvfFlatSearchParams] = None, *,
+                   mesh: Mesh, axis: str = "shard"
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """Multi-chip search: each shard probes its local lists (n_probes per
+    shard — recall ≥ single-chip at equal n_probes), one all_gather merges.
+
+    Per-shard probing searches each shard's nearest local lists, so the union
+    over shards always covers the globally nearest lists.
+    """
+    p = params or IvfFlatSearchParams()
+    q = wrap_array(queries, ndim=2, name="queries")
+    n_dev = int(mesh.shape[axis])
+    local_lists = index.n_lists // n_dev
+    n_probes = min(p.n_probes, local_lists)
+    return _search_sharded_impl(mesh, axis, index.centroids, index.data,
+                                index.ids, index.counts, index.norms, q,
+                                int(k), int(n_probes), index.metric)
