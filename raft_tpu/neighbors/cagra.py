@@ -1,0 +1,399 @@
+"""CAGRA-class graph index — fixed-degree kNN graph + beam search.
+
+No in-tree CUDA ancestor (cuVS migration); designed from the north-star
+configs (``BASELINE.json``: cagra on DEEP-100M sharded) and the CAGRA idea
+(build a high-quality fixed-out-degree proximity graph offline, search it
+with a greedy multi-candidate descent).
+
+TPU redesign:
+* **Build**: a kNN graph (brute-force or IVF-sourced) is *optimized* by
+  rank-based forward/reverse edge merging — every node keeps the
+  best-ranked union of its out-edges and in-edges, deduplicated, truncated
+  to ``graph_degree``.  This is the vectorizable core of CAGRA's
+  detour-pruning heuristic: reverse edges give the connectivity the pruning
+  step is after, rank interleaving approximates its edge ordering.  The
+  whole optimization is numpy index arithmetic — no kernels.
+* **Search**: breadth-limited greedy descent with a fixed iteration count —
+  per step: pick the ``search_width`` best unexplored beam entries, gather
+  their adjacency rows ([nq, width·deg] candidates), compute exact distances
+  with one batched MXU dot, dedup by id (sort-by-id + adjacent-equality mask
+  — the XLA replacement for CAGRA's per-thread hash table), and merge into
+  the beam with ``select_k``.  Everything static-shape; one compile per
+  (nq, k, itopk, width, iters) config.
+* **Sharded**: database sharded over the mesh axis; each shard runs the same
+  search program on its sub-graph and one ``all_gather`` + ``select_k``
+  merges — identical pattern to IVF-Flat sharded (SURVEY.md §5.7).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..core.array import wrap_array
+from ..core.errors import expects
+from ..matrix.select_k import select_k
+
+__all__ = [
+    "CagraIndexParams",
+    "CagraSearchParams",
+    "CagraIndex",
+    "build",
+    "build_from_graph",
+    "build_sharded",
+    "optimize_graph",
+    "search",
+    "search_sharded",
+    "ShardedCagraIndex",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class CagraIndexParams:
+    intermediate_graph_degree: int = 64
+    graph_degree: int = 32
+    metric: str = "sqeuclidean"
+    build_algo: str = "brute_force"  # brute_force | ivf
+    n_routers: int = 128  # entry-point table size (see _build_routers)
+    seed: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class CagraSearchParams:
+    itopk_size: int = 64      # beam width (internal top-k)
+    search_width: int = 4     # parents expanded per iteration
+    max_iterations: int = 0   # 0 → auto from itopk/width
+    n_seeds: int = 32         # random entry points
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class CagraIndex:
+    dataset: jax.Array         # [n, d] — graph search recomputes exact distances
+    graph: jax.Array           # [n, graph_degree] int32 adjacency
+    router_centroids: jax.Array  # [R, d] coarse kmeans centroids
+    router_nodes: jax.Array    # [R] nearest dataset node per centroid
+    metric: str = dataclasses.field(metadata=dict(static=True))
+
+    @property
+    def size(self) -> int:
+        return int(self.dataset.shape[0])
+
+    @property
+    def dim(self) -> int:
+        return int(self.dataset.shape[1])
+
+    @property
+    def graph_degree(self) -> int:
+        return int(self.graph.shape[1])
+
+
+def optimize_graph(knn_graph: np.ndarray, graph_degree: int) -> np.ndarray:
+    """Rank-merge optimization: union of forward and reverse edges ordered by
+    rank, deduplicated, truncated to ``graph_degree`` per node.
+
+    Fully vectorized numpy (build is host-driven): forward edge (u→v, rank r)
+    contributes (u, v, 2r) and reverse (v, u, 2r+1) — interleaving forward
+    and reverse ranks like CAGRA's edge reordering.
+    """
+    n, kk = knn_graph.shape
+    src_f = np.repeat(np.arange(n, dtype=np.int64), kk)
+    dst_f = knn_graph.reshape(-1).astype(np.int64)
+    rank_f = np.tile(np.arange(kk, dtype=np.int64), n)
+    src = np.concatenate([src_f, dst_f])
+    dst = np.concatenate([dst_f, src_f])
+    rank = np.concatenate([2 * rank_f, 2 * rank_f + 1])
+    # drop self-loops
+    keep = src != dst
+    src, dst, rank = src[keep], dst[keep], rank[keep]
+    # dedup (src, dst) keeping the best rank: sort by (src, dst, rank)
+    order = np.lexsort((rank, dst, src))
+    src, dst, rank = src[order], dst[order], rank[order]
+    first = np.ones(src.shape[0], bool)
+    first[1:] = (src[1:] != src[:-1]) | (dst[1:] != dst[:-1])
+    src, dst, rank = src[first], dst[first], rank[first]
+    # per-source, keep graph_degree best ranks
+    order = np.lexsort((rank, src))
+    src, dst = src[order], dst[order]
+    counts = np.bincount(src, minlength=n)
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    pos = np.arange(src.shape[0]) - starts[src]
+    ok = pos < graph_degree
+    graph = np.tile(np.arange(n, dtype=np.int32)[:, None], (1, graph_degree))
+    graph[src[ok], pos[ok]] = dst[ok].astype(np.int32)
+    # pad short rows with the node's own best neighbors cyclically
+    short = counts < graph_degree
+    if short.any():
+        for u in np.nonzero(short)[0]:
+            c = counts[u]
+            if c == 0:
+                continue
+            reps = np.resize(graph[u, :c], graph_degree - c)
+            graph[u, c:] = reps
+    return graph
+
+
+def build(dataset, params: Optional[CagraIndexParams] = None, *,
+          res=None) -> CagraIndex:
+    """Build the optimized graph from scratch."""
+    p = params or CagraIndexParams()
+    x = wrap_array(dataset, ndim=2, name="dataset")
+    n = x.shape[0]
+    kk = min(p.intermediate_graph_degree, n - 1)
+    if p.build_algo == "ivf" and n >= 4096:
+        from . import ivf_flat
+
+        ip = ivf_flat.IvfFlatIndexParams(
+            n_lists=max(16, int(np.sqrt(n))), metric=p.metric, seed=p.seed)
+        index = ivf_flat.build(x, ip)
+        _, nbrs = ivf_flat.search(index, x, kk + 1,
+                                  ivf_flat.IvfFlatSearchParams(n_probes=16))
+    else:
+        from . import brute_force
+
+        _, nbrs = brute_force.knn(x, x, kk + 1, metric=p.metric)
+    nbrs = np.asarray(nbrs)
+    # remove self matches: stable-sort non-self entries first, keep kk
+    for_self = nbrs == np.arange(n)[:, None]
+    order = np.argsort(for_self, axis=1, kind="stable")  # False < True
+    cleaned = np.take_along_axis(nbrs, order, axis=1)[:, :kk].astype(np.int32)
+    graph = optimize_graph(cleaned, p.graph_degree)
+    routers, router_nodes = _build_routers(x, min(p.n_routers, n), p.seed)
+    return CagraIndex(x, jnp.asarray(graph), routers, router_nodes, p.metric)
+
+
+def _build_routers(x, n_routers: int, seed: int):
+    """Entry-point table: coarse kmeans centroids + their nearest dataset
+    node.  Per-query seeds from this table reach every region of the dataset
+    — graph search needs an entry in each connected component (random seeds
+    alone miss components; this is the DiskANN-medoid idea, pluralized)."""
+    from ..cluster.kmeans import KMeansParams, kmeans_fit
+    from ..distance.fused import fused_l2_nn_argmin
+
+    kp = KMeansParams(n_clusters=n_routers, max_iter=8, seed=seed, init="random")
+    n = x.shape[0]
+    sub = x[jax.random.permutation(jax.random.PRNGKey(seed), n)[: min(n, 50 * n_routers)]]
+    centroids, _, _ = kmeans_fit(sub, kp)
+    nodes = fused_l2_nn_argmin(centroids, x).astype(jnp.int32)  # [R]
+    return centroids, nodes
+
+
+def build_from_graph(dataset, knn_graph, graph_degree: int = 32,
+                     metric: str = "sqeuclidean", n_routers: int = 128,
+                     seed: int = 0) -> CagraIndex:
+    """Build from a precomputed kNN graph (cuVS ``build`` overload parity)."""
+    x = wrap_array(dataset, ndim=2, name="dataset")
+    graph = optimize_graph(np.asarray(knn_graph), graph_degree)
+    routers, router_nodes = _build_routers(x, min(n_routers, x.shape[0]), seed)
+    return CagraIndex(x, jnp.asarray(graph), routers, router_nodes, metric)
+
+
+def _batch_dists(dataset, q, qn, ids, metric: str):
+    """Exact query→candidate distances: [nq, L] for ids [nq, L]."""
+    vecs = dataset[jnp.maximum(ids, 0)]  # [nq, L, d]
+    dots = jnp.einsum("qld,qd->ql", vecs, q,
+                      preferred_element_type=jnp.float32,
+                      precision=jax.lax.Precision.HIGHEST)
+    if metric == "inner_product":
+        return -dots
+    vn = jnp.sum(vecs.astype(jnp.float32) ** 2, axis=2)
+    return jnp.maximum(vn - 2.0 * dots + qn[:, None], 0.0)
+
+
+def _dedup_by_id(vals, ids):
+    """Invalidate duplicate ids (keep best): sort by (id, val) via two stable
+    argsorts, mask adjacent equals — the hash-table replacement."""
+    order = jnp.argsort(vals, axis=1, stable=True)
+    v1 = jnp.take_along_axis(vals, order, axis=1)
+    i1 = jnp.take_along_axis(ids, order, axis=1)
+    order2 = jnp.argsort(i1, axis=1, stable=True)  # by id, best-val first in ties
+    v2 = jnp.take_along_axis(v1, order2, axis=1)
+    i2 = jnp.take_along_axis(i1, order2, axis=1)
+    dup = jnp.concatenate(
+        [jnp.zeros((ids.shape[0], 1), bool), i2[:, 1:] == i2[:, :-1]], axis=1
+    )
+    v2 = jnp.where(dup | (i2 < 0), jnp.inf, v2)
+    return v2, i2
+
+
+@partial(jax.jit, static_argnames=("k", "itopk", "width", "iters", "n_seeds",
+                                   "metric"))
+def _search_impl(dataset, graph, routers, router_nodes, q, key, k: int,
+                 itopk: int, width: int, iters: int, n_seeds: int,
+                 metric: str):
+    nq, d = q.shape
+    n = dataset.shape[0]
+    deg = graph.shape[1]
+    qf = q.astype(jnp.float32)
+    qn = jnp.sum(qf * qf, axis=1)
+
+    # per-query seeds: nearest router entry nodes (covers every dataset
+    # region incl. disconnected components) + shared random extras
+    from ..distance.pairwise import sq_l2
+
+    rd = sq_l2(q, routers)                                  # [nq, R]
+    n_route = min(n_seeds, routers.shape[0])
+    _, rsel = jax.lax.top_k(-rd, n_route)
+    seed_ids = router_nodes[rsel]                           # [nq, n_route]
+    if n_seeds > n_route:
+        extra = jax.random.choice(key, n, (n_seeds - n_route,),
+                                  replace=False).astype(jnp.int32)
+        seed_ids = jnp.concatenate(
+            [seed_ids, jnp.tile(extra[None, :], (nq, 1))], axis=1
+        )
+    seed_vals = _batch_dists(dataset, qf, qn, seed_ids, metric)
+    seed_vals, seed_ids = _dedup_by_id(seed_vals, seed_ids)
+    beam_val, beam_idx = select_k(seed_vals, itopk, in_idx=seed_ids,
+                                  select_min=True)
+    explored = jnp.zeros((nq, itopk), bool) | (beam_idx < 0)
+
+    def step(carry, _):
+        beam_val, beam_idx, explored = carry
+        # pick `width` best unexplored parents
+        pv = jnp.where(explored, jnp.inf, beam_val)
+        _, ppos = jax.lax.top_k(-pv, width)           # positions in beam
+        parents = jnp.take_along_axis(beam_idx, ppos, axis=1)  # [nq, w]
+        live = jnp.isfinite(jnp.take_along_axis(pv, ppos, axis=1))
+        explored = explored.at[jnp.arange(nq)[:, None], ppos].set(True)
+        # expand adjacency
+        nbrs = graph[jnp.maximum(parents, 0)].reshape(nq, width * deg)
+        nbrs = jnp.where(jnp.repeat(live, deg, axis=1), nbrs, -1)
+        nvals = _batch_dists(dataset, qf, qn, nbrs, metric)
+        nvals = jnp.where(nbrs >= 0, nvals, jnp.inf)
+        # merge + dedup
+        all_vals = jnp.concatenate([beam_val, nvals], axis=1)
+        all_ids = jnp.concatenate([beam_idx, nbrs], axis=1)
+        all_flags = jnp.concatenate(
+            [explored, jnp.zeros((nq, width * deg), bool)], axis=1
+        )
+        dv, di = _dedup_by_id(all_vals, all_ids)
+        pos = jnp.tile(jnp.arange(dv.shape[1])[None, :], (nq, 1))
+        mv, mpos = select_k(dv, itopk, in_idx=pos, select_min=True)
+        mi = jnp.take_along_axis(di, mpos, axis=1)
+        # carry explored flags through the same permutation chain:
+        # recompute flags by membership — an id stays explored if it was
+        # explored in the old beam (membership test via dedup trick)
+        oe_val = jnp.where(explored, 0.0, 1.0)
+        # map: for each merged id, explored iff it matches an explored old id
+        # O(itopk * itopk) pairwise — small (64×64) and fuses to one VPU op
+        match = (mi[:, :, None] == jnp.where(explored, beam_idx, -2)[:, None, :])
+        mflags = jnp.any(match, axis=2) | (mi < 0)
+        return (mv, mi, mflags), None
+
+    (beam_val, beam_idx, _), _ = jax.lax.scan(
+        step, (beam_val, beam_idx, explored), None, length=iters
+    )
+    out_val, pos = select_k(beam_val, k, select_min=True)
+    out_idx = jnp.take_along_axis(beam_idx, pos, axis=1)
+    if metric == "euclidean":
+        out_val = jnp.sqrt(jnp.maximum(out_val, 0.0))
+    elif metric == "inner_product":
+        out_val = -out_val
+    return out_val, out_idx
+
+
+def build_sharded(dataset, mesh: Mesh,
+                  params: Optional[CagraIndexParams] = None, *,
+                  axis: str = "shard") -> "ShardedCagraIndex":
+    """Partition rows over the mesh axis and build one sub-graph per shard.
+
+    Each shard's graph indexes *local* row positions; global ids are
+    ``shard * rows_per_shard + local`` (rows padded to divide evenly).
+    The MNMG index-shard model of SURVEY.md §5.7 on ICI.
+    """
+    p = params or CagraIndexParams()
+    x = np.asarray(wrap_array(dataset, ndim=2, name="dataset"))
+    n, d = x.shape
+    n_dev = int(mesh.shape[axis])
+    per = (n + n_dev - 1) // n_dev
+    pad = per * n_dev - n
+    if pad:
+        x = np.concatenate([x, np.tile(x[:1], (pad, 1))], axis=0)
+    subs = [build(x[s * per : (s + 1) * per], p) for s in range(n_dev)]
+    stack = lambda f: jnp.stack([f(s) for s in subs])
+    sharding = jax.sharding.NamedSharding(mesh, P(axis))
+    put = lambda a: jax.device_put(a, sharding)
+    return ShardedCagraIndex(
+        put(stack(lambda s: s.dataset)),
+        put(stack(lambda s: s.graph)),
+        put(stack(lambda s: s.router_centroids)),
+        put(stack(lambda s: s.router_nodes)),
+        p.metric, n,
+    )
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class ShardedCagraIndex:
+    datasets: jax.Array          # [S, per, d]
+    graphs: jax.Array            # [S, per, deg]
+    router_centroids: jax.Array  # [S, R, d]
+    router_nodes: jax.Array      # [S, R]
+    metric: str = dataclasses.field(metadata=dict(static=True))
+    n_rows: int = dataclasses.field(metadata=dict(static=True))
+
+
+def search_sharded(index: ShardedCagraIndex, queries, k: int,
+                   params: Optional[CagraSearchParams] = None, *,
+                   mesh: Mesh, axis: str = "shard", seed: int = 0
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """Every shard searches its sub-graph with the same program; one
+    all_gather + select_k merges the per-shard top-k (ids globalized)."""
+    p = params or CagraSearchParams()
+    q = wrap_array(queries, ndim=2, name="queries")
+    itopk = max(p.itopk_size, k)
+    iters = p.max_iterations or max(1, (itopk + p.search_width - 1)
+                                    // p.search_width)
+    per = int(index.datasets.shape[1])
+    key = jax.random.PRNGKey(seed)
+    n_seeds = int(min(p.n_seeds, per))
+    metric = index.metric
+    kk, width = int(k), int(p.search_width)
+
+    def local(ds, g, rc, rn, q_l):
+        bv, bi = _search_impl(ds[0], g[0], rc[0], rn[0], q_l, key, kk,
+                              int(itopk), width, int(iters), n_seeds, metric)
+        shard = jax.lax.axis_index(axis)
+        bi = jnp.where(bi >= 0, bi + shard * per, bi)
+        if metric == "inner_product":
+            bv = -bv  # back to min-selectable before masking
+        bv = jnp.where((bi >= 0) & (bi < index.n_rows), bv, jnp.inf)
+        av = jax.lax.all_gather(bv, axis)
+        ai = jax.lax.all_gather(bi, axis)
+        av = jnp.moveaxis(av, 0, 1).reshape(q_l.shape[0], -1)
+        ai = jnp.moveaxis(ai, 0, 1).reshape(q_l.shape[0], -1)
+        fv, fi = select_k(av, kk, in_idx=ai, select_min=True)
+        if metric == "inner_product":
+            fv = -fv
+        return fv, fi
+
+    return jax.jit(jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(axis), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    ))(index.datasets, index.graphs, index.router_centroids,
+       index.router_nodes, q)
+
+
+def search(index: CagraIndex, queries, k: int,
+           params: Optional[CagraSearchParams] = None, *, seed: int = 0,
+           res=None) -> Tuple[jax.Array, jax.Array]:
+    """Graph beam search: returns ``(distances, ids)`` of (nq, k)."""
+    p = params or CagraSearchParams()
+    q = wrap_array(queries, ndim=2, name="queries")
+    expects(q.shape[1] == index.dim, "query dim mismatch")
+    itopk = max(p.itopk_size, k)
+    iters = p.max_iterations or max(1, (itopk + p.search_width - 1)
+                                    // p.search_width)
+    key = jax.random.PRNGKey(seed)
+    return _search_impl(index.dataset, index.graph, index.router_centroids,
+                        index.router_nodes, q, key, int(k),
+                        int(itopk), int(p.search_width), int(iters),
+                        int(min(p.n_seeds, index.size)), index.metric)

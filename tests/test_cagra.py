@@ -1,0 +1,93 @@
+"""CAGRA graph index tests: graph structure invariants + search recall vs
+brute force."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.neighbors import brute_force, cagra
+from raft_tpu.random.datagen import make_blobs
+from raft_tpu.stats.neighborhood import neighborhood_recall
+
+
+@pytest.fixture(scope="module")
+def blob_data():
+    x, _ = make_blobs(jax.random.PRNGKey(2), n_samples=5000, n_features=32,
+                      n_clusters=25, cluster_std=1.2)
+    return np.asarray(x), np.asarray(x[:150])
+
+
+def _recall(got, want):
+    return float(neighborhood_recall(jnp.asarray(got), jnp.asarray(want)))
+
+
+def test_optimize_graph_shape_and_no_self():
+    knn = np.asarray([[1, 2, 3], [0, 2, 3], [0, 1, 3], [0, 1, 2]], np.int32)
+    g = cagra.optimize_graph(knn, 2)
+    assert g.shape == (4, 2)
+    for u in range(4):
+        assert u not in g[u].tolist()
+
+
+def test_cagra_recall(blob_data):
+    x, q = blob_data
+    params = cagra.CagraIndexParams(intermediate_graph_degree=48,
+                                    graph_degree=24)
+    index = cagra.build(x, params)
+    assert index.graph.shape == (x.shape[0], 24)
+    _, want = brute_force.knn(q, x, 10)
+    _, got = cagra.search(index, q, 10,
+                          cagra.CagraSearchParams(itopk_size=64,
+                                                  search_width=4,
+                                                  n_seeds=32))
+    assert _recall(got, want) > 0.9
+
+
+def test_cagra_higher_effort_higher_recall(blob_data):
+    x, q = blob_data
+    index = cagra.build(x, cagra.CagraIndexParams(graph_degree=16,
+                                                  intermediate_graph_degree=32))
+    _, want = brute_force.knn(q, x, 10)
+    _, low = cagra.search(index, q, 10,
+                          cagra.CagraSearchParams(itopk_size=16,
+                                                  search_width=1,
+                                                  max_iterations=2, n_seeds=4))
+    _, high = cagra.search(index, q, 10,
+                           cagra.CagraSearchParams(itopk_size=96,
+                                                   search_width=8, n_seeds=48))
+    assert _recall(high, want) >= _recall(low, want)
+    assert _recall(high, want) > 0.85
+
+
+def test_cagra_build_from_graph(blob_data):
+    x, q = blob_data
+    _, nbrs = brute_force.knn(x, x, 33)
+    index = cagra.build_from_graph(x, np.asarray(nbrs)[:, 1:], graph_degree=24)
+    _, want = brute_force.knn(q, x, 5)
+    _, got = cagra.search(index, q, 5)
+    assert _recall(got, want) > 0.9
+
+
+def test_cagra_no_duplicate_results(blob_data):
+    x, q = blob_data
+    index = cagra.build(x, cagra.CagraIndexParams(graph_degree=16,
+                                                  intermediate_graph_degree=32))
+    _, got = cagra.search(index, q, 10)
+    got = np.asarray(got)
+    for row in got:
+        valid = row[row >= 0]
+        assert len(set(valid.tolist())) == len(valid)
+
+
+def test_cagra_sharded(blob_data, mesh8):
+    x, q = blob_data
+    params = cagra.CagraIndexParams(intermediate_graph_degree=32,
+                                    graph_degree=16)
+    index = cagra.build_sharded(x, mesh8, params)
+    _, want = brute_force.knn(q, x, 10)
+    _, got = cagra.search_sharded(
+        index, q, 10,
+        cagra.CagraSearchParams(itopk_size=32, search_width=4, n_seeds=16),
+        mesh=mesh8)
+    assert _recall(got, want) > 0.9
