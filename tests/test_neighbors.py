@@ -85,3 +85,19 @@ def test_end_to_end_blobs_recall(rng):
     ref_d, ref_i = _ref_knn(x, x, 10)
     d, i = knn(x, x, 10, tile=64)
     assert float(neighborhood_recall(np.asarray(i), ref_i)) >= 0.999
+
+
+@pytest.mark.parametrize("metric", ["sqeuclidean", "euclidean", "cosine", "inner_product"])
+def test_knn_fast_mode(rng, metric):
+    """fast mode = bf16 shortlist + exact refine; on the CPU fallback the
+    shortlist is wide enough that results should match exact for small n."""
+    x = rng.standard_normal((12, 24)).astype(np.float32)
+    y = rng.standard_normal((300, 24)).astype(np.float32)
+    d_ref, i_ref = knn(x, y, 5, metric=metric)
+    d, i = knn(x, y, 5, metric=metric, mode="fast", cand=64)
+    rec = np.mean([len(set(a) & set(b)) for a, b in
+                   zip(np.asarray(i_ref), np.asarray(i))]) / 5
+    assert rec >= 0.95, rec
+    np.testing.assert_allclose(np.sort(np.asarray(d), axis=1),
+                               np.sort(np.asarray(d_ref), axis=1)[:, :5],
+                               rtol=2e-2, atol=2e-2)

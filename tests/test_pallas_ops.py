@@ -1,0 +1,52 @@
+"""Pallas kernel tests (interpret mode on the CPU mesh — same kernel code
+that compiles on TPU; SURVEY.md §4's "test both compiled and exported
+paths" discipline)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.ops.pallas.fused_l2_topk import fused_shortlist
+from raft_tpu.ops.pallas.select_k import select_k_pallas
+
+
+@pytest.mark.parametrize("batch,length,k", [(16, 300, 5), (9, 128, 3), (32, 4096, 32)])
+def test_select_k_pallas_exact(rng, batch, length, k):
+    x = rng.normal(size=(batch, length)).astype(np.float32)
+    v, i = select_k_pallas(jnp.asarray(x), k)
+    v, i = np.asarray(v), np.asarray(i)
+    np.testing.assert_allclose(v, np.sort(x, axis=1)[:, :k])
+    assert np.all(np.take_along_axis(x, i, axis=1) == v)
+
+
+def test_select_k_pallas_max(rng):
+    x = rng.normal(size=(8, 500)).astype(np.float32)
+    v, _ = select_k_pallas(jnp.asarray(x), 4, select_min=False)
+    np.testing.assert_allclose(np.asarray(v), -np.sort(-x, axis=1)[:, :4])
+
+
+def test_fused_shortlist_contains_true_topk(rng):
+    m, n, d, k = 32, 6000, 96, 10
+    x = rng.normal(size=(m, d)).astype(np.float32)
+    y = rng.normal(size=(n, d)).astype(np.float32)
+    yn = (y * y).sum(axis=1).astype(np.float32)
+    _, si = fused_shortlist(jnp.asarray(x), jnp.asarray(y), jnp.asarray(yn),
+                            bm=32, bn=512)
+    si = np.asarray(si)
+    d2 = ((x[:, None, :] - y[None, :, :]) ** 2).sum(-1)
+    true = np.argsort(d2, axis=1)[:, :k]
+    rec = np.mean([len(set(t) & set(s)) for t, s in zip(true, si)]) / k
+    assert rec > 0.99, rec
+
+
+def test_fused_shortlist_padding(rng):
+    # n not a multiple of bn: padded rows must never surface
+    m, n, d = 8, 700, 64
+    x = rng.normal(size=(m, d)).astype(np.float32)
+    y = rng.normal(size=(n, d)).astype(np.float32)
+    yn = (y * y).sum(axis=1).astype(np.float32)
+    sv, si = fused_shortlist(jnp.asarray(x), jnp.asarray(y), jnp.asarray(yn),
+                             bm=8, bn=512)
+    si, sv = np.asarray(si), np.asarray(sv)
+    finite = np.isfinite(sv)
+    assert np.all(si[finite] >= 0) and np.all(si[finite] < n)
