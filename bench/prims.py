@@ -1,0 +1,202 @@
+"""Micro-benchmark suites — parity with ``cpp/bench/prims``
+(``cpp/bench/prims/CMakeLists.txt:70-97``: select_k, reduce, norm, gather,
+rng, make_blobs, sparse conversions, sddmm, masked_matmul, popc, bitset;
+fixture ``common/benchmark.hpp:99,344``).
+
+Usage:  python bench/prims.py [suite ...] [--quick]
+
+Prints one JSON line per case: {"suite", "case", "ms", "items_per_s"}.
+Times are min-of-3 with host-fetch barriers (the only reliable sync on the
+remote-TPU tunnel — see bench.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _sync(out):
+    for leaf in jax.tree_util.tree_leaves(out):
+        np.asarray(leaf)
+    return out
+
+
+def _time(fn, reps=3):
+    _sync(fn())
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _sync(fn())
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def report(suite, case, seconds, items):
+    print(json.dumps({"suite": suite, "case": case,
+                      "ms": round(seconds * 1e3, 3),
+                      "items_per_s": round(items / seconds, 1)}))
+
+
+def bench_select_k(quick):
+    from raft_tpu.matrix import SelectAlgo, select_k
+
+    shapes = [(1024, 16384, 32)] if quick else [
+        (1024, 16384, 32), (4096, 65536, 10), (16384, 8192, 64)]
+    key = jax.random.PRNGKey(0)
+    for rows, cols, k in shapes:
+        x = jax.block_until_ready(jax.random.normal(key, (rows, cols), jnp.float32))
+        for algo in (SelectAlgo.kTopK, SelectAlgo.kPartialBitonic, SelectAlgo.kBinSelect):
+            if algo is SelectAlgo.kPartialBitonic and k > 64:
+                continue
+            try:
+                t = _time(lambda a=algo: select_k(x, k, algo=a))
+            except Exception:
+                continue
+            report("select_k", f"{rows}x{cols}_k{k}_{algo.name}", t, rows)
+
+
+def bench_reduce(quick):
+    from raft_tpu.linalg import reduce as lreduce
+    from raft_tpu.linalg.reduce import Apply
+
+    shapes = [(4096, 4096)] if quick else [(4096, 4096), (32768, 1024), (256, 262144)]
+    key = jax.random.PRNGKey(1)
+    for r, c in shapes:
+        x = jax.block_until_ready(jax.random.normal(key, (r, c), jnp.float32))
+        t = _time(lambda: lreduce(x, apply=Apply.ALONG_ROWS))
+        report("reduce", f"{r}x{c}_rows", t, r * c)
+
+
+def bench_norm(quick):
+    from raft_tpu.linalg import row_norm
+
+    key = jax.random.PRNGKey(2)
+    x = jax.block_until_ready(jax.random.normal(key, (16384, 512), jnp.float32))
+    t = _time(lambda: row_norm(x, norm_type="l2"))
+    report("norm", "16384x512_l2", t, x.size)
+
+
+def bench_gather(quick):
+    from raft_tpu.matrix import gather
+
+    key = jax.random.PRNGKey(3)
+    x = jax.block_until_ready(jax.random.normal(key, (1 << 20, 64), jnp.float32))
+    idx = jax.block_until_ready(
+        jax.random.randint(key, (1 << 16,), 0, 1 << 20, jnp.int32))
+    t = _time(lambda: gather(x, idx))
+    report("gather", "1Mx64_take64k", t, int(idx.size))
+
+
+def bench_rng(quick):
+    from raft_tpu.random import RngState, normal, uniform
+
+    n = 1 << 22 if quick else 1 << 24
+    st = RngState(0)
+    t = _time(lambda: uniform(st, (n,)))
+    report("rng", f"uniform_{n}", t, n)
+    t = _time(lambda: normal(st, (n,)))
+    report("rng", f"normal_{n}", t, n)
+
+
+def bench_make_blobs(quick):
+    from raft_tpu.random import RngState, make_blobs
+
+    n = 1 << 18
+    t = _time(lambda: make_blobs(RngState(0), n, 64, n_clusters=64))
+    report("make_blobs", f"{n}x64_c64", t, n)
+
+
+def bench_sparse_convert(quick):
+    from raft_tpu.sparse import dense_to_csr, csr_to_dense
+
+    key = jax.random.PRNGKey(4)
+    dense = jax.random.normal(key, (2048, 2048), jnp.float32)
+    dense = jax.block_until_ready(
+        jnp.where(jax.random.uniform(key, dense.shape) < 0.05, dense, 0.0))
+    t = _time(lambda: dense_to_csr(dense))
+    report("sparse_convert", "dense_to_csr_2048^2_5pct", t, dense.size)
+    csr = dense_to_csr(dense)
+    t = _time(lambda: csr_to_dense(csr))
+    report("sparse_convert", "csr_to_dense_2048^2_5pct", t, dense.size)
+
+
+def bench_sddmm(quick):
+    from raft_tpu.sparse import dense_to_csr, sddmm
+
+    key = jax.random.PRNGKey(5)
+    a = jax.block_until_ready(jax.random.normal(key, (2048, 256), jnp.float32))
+    b = jax.block_until_ready(jax.random.normal(key, (256, 2048), jnp.float32))
+    mask = jnp.where(jax.random.uniform(key, (2048, 2048)) < 0.02, 1.0, 0.0)
+    s = dense_to_csr(jax.block_until_ready(mask))
+    t = _time(lambda: sddmm(a, b, s).data)
+    report("sddmm", "2048^2_2pct_k256", t, int(s.nnz))
+
+
+def bench_masked_matmul(quick):
+    from raft_tpu.sparse import dense_to_csr, masked_matmul
+
+    key = jax.random.PRNGKey(6)
+    a = jax.block_until_ready(jax.random.normal(key, (2048, 256), jnp.float32))
+    b = jax.block_until_ready(jax.random.normal(key, (2048, 256), jnp.float32))
+    mask = jnp.where(jax.random.uniform(key, (2048, 2048)) < 0.02, 1.0, 0.0)
+    s = dense_to_csr(jax.block_until_ready(mask))
+    t = _time(lambda: masked_matmul(a, b, s).data)
+    report("masked_matmul", "2048^2_2pct_k256", t, int(s.nnz))
+
+
+def bench_bitset(quick):
+    from raft_tpu.core.bitset import Bitset, popc
+
+    n = 1 << 24
+    key = jax.random.PRNGKey(7)
+    idx = jax.block_until_ready(
+        jax.random.randint(key, (1 << 18,), 0, n, jnp.int32))
+    bs = Bitset.zeros(n) if hasattr(Bitset, "zeros") else Bitset(
+        jnp.zeros(((n + 31) // 32,), jnp.uint32), n)
+    t = _time(lambda: bs.set(idx).words)
+    report("bitset", f"set_{1 << 18}_of_{n}", t, 1 << 18)
+    bs2 = bs.set(idx)
+    t = _time(lambda: popc(bs2.words))
+    report("bitset", f"popc_{n}", t, n)
+
+
+SUITES = {
+    "select_k": bench_select_k,
+    "reduce": bench_reduce,
+    "norm": bench_norm,
+    "gather": bench_gather,
+    "rng": bench_rng,
+    "make_blobs": bench_make_blobs,
+    "sparse_convert": bench_sparse_convert,
+    "sddmm": bench_sddmm,
+    "masked_matmul": bench_masked_matmul,
+    "bitset": bench_bitset,
+}
+
+
+def main() -> None:
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    quick = "--quick" in sys.argv
+    names = args or list(SUITES)
+    for name in names:
+        fn = SUITES.get(name)
+        if fn is None:
+            print(f"unknown suite {name!r}; have {sorted(SUITES)}", file=sys.stderr)
+            continue
+        try:
+            fn(quick)
+        except Exception as e:  # noqa: BLE001 — keep the harness going
+            print(json.dumps({"suite": name, "error": f"{type(e).__name__}: {e}"}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,89 @@
+"""Offline select_k dispatch tuning — the reference's trained-heuristic
+pattern (``cpp/scripts/heuristics/select_k/generate_heuristic.ipynb``:
+time every algorithm over a (rows, cols, k) grid, bake the winner table
+into the dispatcher).
+
+Run on the target backend (real TPU for production numbers):
+
+    python bench/tune_select_k.py [--quick]
+
+Writes ``raft_tpu/matrix/_select_k_table.json``, keyed by
+``rows.bit_length():cols.bit_length():k.bit_length()`` buckets;
+``matrix.select_k``'s ``kAuto`` consults it at call time (absent entries
+fall back to ``lax.top_k``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.matrix.select_k import SelectAlgo, select_k
+
+GRID_ROWS = [256, 2048, 16384]
+GRID_COLS = [1024, 16384, 131072]
+GRID_K = [8, 32, 128]
+CANDIDATES = [SelectAlgo.kTopK, SelectAlgo.kPartialBitonic, SelectAlgo.kBinSelect]
+
+
+def _time(fn, reps=3):
+    out = fn()
+    np.asarray(out[0])  # host fetch = only reliable barrier on the tunnel
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn()
+        np.asarray(out[0])
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def main() -> None:
+    quick = "--quick" in sys.argv
+    rows_grid = GRID_ROWS[:2] if quick else GRID_ROWS
+    cols_grid = GRID_COLS[:2] if quick else GRID_COLS
+    table = {}
+    key0 = jax.random.PRNGKey(0)
+    for rows in rows_grid:
+        for cols in cols_grid:
+            x = jax.block_until_ready(
+                jax.random.normal(key0, (rows, cols), jnp.float32))
+            for k in GRID_K:
+                if k >= cols:
+                    continue
+                best_algo, best_t = None, float("inf")
+                for algo in CANDIDATES:
+                    if algo is SelectAlgo.kPartialBitonic and k > 64:
+                        continue  # linear-in-k kernel: not competitive
+                    try:
+                        t = _time(lambda a=algo: select_k(x, k, algo=a))
+                    except Exception as e:  # noqa: BLE001 — skip non-lowering algos
+                        print(f"  {algo.name} rows={rows} cols={cols} k={k}: "
+                              f"failed ({type(e).__name__})", file=sys.stderr)
+                        continue
+                    if t < best_t:
+                        best_algo, best_t = algo, t
+                if best_algo is None:
+                    continue
+                bucket = (f"{rows.bit_length()}:{cols.bit_length()}"
+                          f":{k.bit_length()}")
+                table[bucket] = best_algo.value
+                print(f"rows={rows:6d} cols={cols:7d} k={k:4d} → "
+                      f"{best_algo.name} ({best_t * 1e3:.2f} ms)")
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                       "raft_tpu", "matrix", "_select_k_table.json")
+    with open(out, "w") as f:
+        json.dump(table, f, indent=1, sort_keys=True)
+    print(f"wrote {len(table)} entries → {os.path.normpath(out)}")
+
+
+if __name__ == "__main__":
+    main()

@@ -19,6 +19,9 @@ from .resources import (
 from .mesh import make_mesh, make_1d_mesh, local_mesh, distributed_init, DATA_AXIS, SHARD_AXIS
 from .array import wrap_array, check_rank, check_same_shape, check_dtype, to_numpy
 from .bitset import Bitset, Bitmap, popc
+from .buffer import MDBuffer, memory_type, memory_type_dispatcher
+from .memory import MemoryTracker, analyze_memory, device_memory_stats, live_bytes
+from .resources_manager import DeviceResourcesManager, get_device_resources
 from .serialize import (
     serialize_mdspan,
     deserialize_mdspan,

@@ -1,0 +1,92 @@
+"""Memory-type-generic buffers — parity with ``mdbuffer``
+(``core/mdbuffer.cuh:391``: view-or-own across memory types, copying only
+when needed) and ``util/memory_type_dispatcher.cuh:107`` (run the right
+overload for where the data lives).
+
+TPU memory types: ``host`` (NumPy) and ``device`` (committed ``jax.Array``).
+The CUDA managed/pinned tiers have no TPU equivalent; like ``mdbuffer``, a
+conversion happens at most once and is cached for the buffer's lifetime.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+__all__ = ["memory_type", "MDBuffer", "memory_type_dispatcher"]
+
+
+def memory_type(x: Any) -> str:
+    """``"host"`` for NumPy/buffer-protocol data, ``"device"`` for
+    ``jax.Array`` (``core/memory_type.hpp:21`` parity)."""
+    return "device" if isinstance(x, jax.Array) else "host"
+
+
+class MDBuffer:
+    """Hold one logical array; serve it in whichever memory type a consumer
+    asks for, converting lazily and at most once (``mdbuffer.cuh:391``).
+
+    >>> buf = MDBuffer(np.arange(4, dtype=np.float32))
+    >>> buf.memory_type
+    'host'
+    >>> dev = buf.device()        # copies host→device once
+    >>> buf.device() is dev       # second ask: cached, no copy
+    True
+    >>> host = buf.host()         # original view — never copied
+    >>> host.dtype.name
+    'float32'
+    """
+
+    def __init__(self, array: Any, *, sharding: Optional[jax.sharding.Sharding] = None):
+        self._origin = memory_type(array)
+        self._views: Dict[str, Any] = {self._origin: array}
+        self._sharding = sharding
+
+    @property
+    def memory_type(self) -> str:
+        """Where the buffer's *original* data lives."""
+        return self._origin
+
+    def host(self) -> np.ndarray:
+        """Host view (device→host copy on first ask only)."""
+        if "host" not in self._views:
+            self._views["host"] = np.asarray(self._views["device"])
+        v = self._views["host"]
+        return v if isinstance(v, np.ndarray) else np.asarray(v)
+
+    def device(self) -> jax.Array:
+        """Device view (host→device transfer on first ask only); honors the
+        sharding given at construction."""
+        if "device" not in self._views:
+            src = self._views["host"]
+            self._views["device"] = (
+                jax.device_put(src, self._sharding) if self._sharding is not None
+                else jax.device_put(src)
+            )
+        return self._views["device"]
+
+    def view(self, mt: str) -> Any:
+        """Generic access — the ``mdbuffer`` visitor surface."""
+        if mt == "host":
+            return self.host()
+        if mt == "device":
+            return self.device()
+        raise ValueError(f"unknown memory type {mt!r}")
+
+
+def memory_type_dispatcher(
+    host_fn: Callable[[Any], Any],
+    device_fn: Callable[[Any], Any],
+    x: Any,
+    *,
+    prefer: Optional[str] = None,
+) -> Any:
+    """Run the overload matching where ``x`` lives
+    (``util/memory_type_dispatcher.cuh:107``): no copy when an overload
+    exists for the data's current type; ``prefer`` forces a conversion
+    first (the dispatcher's mdbuffer-conversion path)."""
+    buf = x if isinstance(x, MDBuffer) else MDBuffer(x)
+    mt = prefer or buf.memory_type
+    return host_fn(buf.host()) if mt == "host" else device_fn(buf.device())

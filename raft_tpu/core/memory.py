@@ -1,0 +1,127 @@
+"""Memory observability — parity with the reference's accounting stack:
+``core/memory_stats_resources.hpp:75`` (allocation-counting handle wrapper,
+incl. dry-run mode), ``mr/statistics_adaptor.hpp:25`` and
+``mr/resource_monitor.hpp:42`` (sampled usage, trace-correlated).
+
+TPU translation: XLA owns the allocator, so accounting hooks at two levels —
+
+* **static analysis** (the dry-run analog): a jitted program's compiled
+  ``memory_analysis`` reports argument/output/temp/peak bytes *without
+  executing* — strictly stronger than the reference's dry-run counter,
+  which must replay an allocation trace;
+* **runtime sampling**: ``device_memory_stats`` (PJRT allocator counters)
+  and ``MemoryTracker`` (live-buffer delta + peak across a scope).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+__all__ = [
+    "MemoryAnalysis",
+    "analyze_memory",
+    "device_memory_stats",
+    "live_bytes",
+    "MemoryTracker",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class MemoryAnalysis:
+    """Compiled-program memory footprint (bytes)."""
+
+    argument_size: int
+    output_size: int
+    temp_size: int
+    alias_size: int
+    generated_code_size: int
+
+    @property
+    def peak_estimate(self) -> int:
+        return self.argument_size + self.output_size + self.temp_size
+
+
+def analyze_memory(fn: Callable, *args, static_argnames=(), **kwargs) -> MemoryAnalysis:
+    """Dry-run memory accounting (``memory_stats_resources`` dry-run parity):
+    lower + compile ``fn`` for the given arguments and report XLA's memory
+    analysis without running it."""
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn, static_argnames=static_argnames)
+    ma = jitted.lower(*args, **kwargs).compile().memory_analysis()
+
+    def _get(*names: str) -> int:
+        for n in names:
+            v = getattr(ma, n, None)
+            if v is not None:
+                return int(v)
+        return 0
+
+    return MemoryAnalysis(
+        argument_size=_get("argument_size_in_bytes"),
+        output_size=_get("output_size_in_bytes"),
+        temp_size=_get("temp_size_in_bytes"),
+        alias_size=_get("alias_size_in_bytes"),
+        generated_code_size=_get("generated_code_size_in_bytes"),
+    )
+
+
+def device_memory_stats(device: Optional[jax.Device] = None) -> Dict[str, Any]:
+    """Allocator counters for one device (``mr/statistics_adaptor`` parity):
+    ``bytes_in_use``, ``peak_bytes_in_use``, … — empty dict on backends that
+    don't expose stats (CPU)."""
+    dev = device if device is not None else jax.local_devices()[0]
+    try:
+        return dict(dev.memory_stats() or {})
+    except (RuntimeError, AttributeError):
+        return {}
+
+
+def live_bytes(platform: Optional[str] = None) -> int:
+    """Total bytes of live ``jax.Array`` buffers (tracking-MR parity,
+    ``core/memory_tracking_resources.hpp``)."""
+    total = 0
+    for arr in jax.live_arrays(platform):
+        try:
+            total += arr.nbytes
+        except Exception:  # deleted/donated buffers
+            pass
+    return total
+
+
+class MemoryTracker:
+    """Scope-based usage tracker (``mr::resource_monitor`` parity).
+
+    >>> with MemoryTracker() as mt:
+    ...     _ = jax.numpy.zeros((256, 256))
+    >>> mt.allocated_delta >= 0
+    True
+    """
+
+    def __init__(self, device: Optional[jax.Device] = None) -> None:
+        self._device = device
+        self.start_live = 0
+        self.end_live = 0
+        self.start_stats: Dict[str, Any] = {}
+        self.end_stats: Dict[str, Any] = {}
+
+    def __enter__(self) -> "MemoryTracker":
+        self.start_live = live_bytes()
+        self.start_stats = device_memory_stats(self._device)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.end_live = live_bytes()
+        self.end_stats = device_memory_stats(self._device)
+
+    @property
+    def allocated_delta(self) -> int:
+        """Live-buffer byte growth across the scope."""
+        return self.end_live - self.start_live
+
+    @property
+    def peak_bytes(self) -> Optional[int]:
+        """Allocator peak inside the scope, when the backend reports it."""
+        peak = self.end_stats.get("peak_bytes_in_use")
+        return int(peak) if peak is not None else None

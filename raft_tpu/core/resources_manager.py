@@ -1,0 +1,104 @@
+"""Process-wide per-device handle pool — parity with
+``core/device_resources_manager.hpp:75`` (``struct device_resources_manager``:
+a lazily-built pool of per-device ``device_resources`` with settings that must
+be fixed before first use).
+
+The CUDA knobs (streams per device, pool sizes, memory limits) map to their
+TPU analogs: default mesh layout over the local devices, RNG seed policy, and
+the handle's workspace byte limit.  Settings changed *after* a handle has been
+vended log a warning and are ignored for already-built handles, exactly like
+the reference (``device_resources_manager.hpp`` "should be called before the
+first get_device_resources").
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+import jax
+
+from .resources import DeviceResources, Resources
+
+__all__ = ["DeviceResourcesManager", "get_device_resources"]
+
+
+class DeviceResourcesManager:
+    """Singleton pool: one ``DeviceResources`` per local device (plus one
+    all-device handle), built lazily, shared across threads."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._handles: Dict[Optional[int], DeviceResources] = {}
+        self._seed = 0
+        self._workspace_limit: Optional[int] = None
+        self._mesh_axes: Tuple[str, ...] = ("data",)
+        self._touched = False
+
+    # -- pre-use configuration (setter-before-first-get contract) ----------
+    def set_seed(self, seed: int) -> None:
+        self._warn_if_touched("set_seed")
+        self._seed = int(seed)
+
+    def set_workspace_limit(self, nbytes: Optional[int]) -> None:
+        self._warn_if_touched("set_workspace_limit")
+        self._workspace_limit = nbytes
+
+    def set_mesh_axes(self, axes: Tuple[str, ...]) -> None:
+        self._warn_if_touched("set_mesh_axes")
+        self._mesh_axes = tuple(axes)
+
+    def _warn_if_touched(self, what: str) -> None:
+        if self._touched:
+            from .logging import default_logger
+
+            default_logger().warning(
+                "%s called after get_device_resources; existing handles keep "
+                "their old settings (device_resources_manager.hpp contract)",
+                what,
+            )
+
+    # -- handle vending ----------------------------------------------------
+    def get_device_resources(self, device_index: Optional[int] = None) -> DeviceResources:
+        """The pooled handle for one local device (or the all-device handle
+        when ``device_index`` is None)."""
+        with self._lock:
+            self._touched = True
+            h = self._handles.get(device_index)
+            if h is None:
+                h = self._build(device_index)
+                self._handles[device_index] = h
+            return h
+
+    def _build(self, device_index: Optional[int]) -> DeviceResources:
+        import numpy as np
+
+        if device_index is None:
+            devices = np.asarray(jax.local_devices())
+            seed = self._seed
+        else:
+            devices = np.asarray([jax.local_devices()[device_index]])
+            seed = self._seed + 1 + device_index  # distinct streams per device
+        if len(self._mesh_axes) == 1:
+            mesh = jax.sharding.Mesh(devices, self._mesh_axes)
+        else:  # trailing axis absorbs the device count
+            shape = (1,) * (len(self._mesh_axes) - 1) + (len(devices),)
+            mesh = jax.sharding.Mesh(devices.reshape(shape), self._mesh_axes)
+        h = DeviceResources(mesh=mesh, seed=seed)
+        h.set_resource(Resources.WORKSPACE_LIMIT, self._workspace_limit)
+        return h
+
+    def reset(self) -> None:
+        """Drop all pooled handles (test hook; not in the reference API)."""
+        with self._lock:
+            self._handles.clear()
+            self._touched = False
+
+
+_manager = DeviceResourcesManager()
+
+
+def get_device_resources(device_index: Optional[int] = None) -> DeviceResources:
+    """Module-level accessor mirroring
+    ``device_resources_manager::get_device_resources()``."""
+    return _manager.get_device_resources(device_index)

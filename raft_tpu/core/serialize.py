@@ -70,13 +70,15 @@ def save_arrays(path: Union[str, os.PathLike], arrays: Dict[str, Any], metadata:
 def load_arrays(path: Union[str, os.PathLike]):
     """Inverse of :func:`save_arrays` → ``(arrays_dict, metadata_dict)``.
 
-    Uses the native mmap fast path from :mod:`raft_tpu.utils.io` when the
+    Uses the native threaded reader from :mod:`raft_tpu.io` when the
     extension is built, else ``np.load``.
     """
+    from .. import io as rio
+
     path = os.fspath(path)
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
     arrays = {}
     for name in meta["arrays"]:
-        arrays[name] = np.load(os.path.join(path, f"{name}.npy"), allow_pickle=False)
+        arrays[name] = rio.read_npy(os.path.join(path, f"{name}.npy"))
     return arrays, meta.get("metadata", {})

@@ -26,6 +26,7 @@ reference.
 from __future__ import annotations
 
 import enum
+import functools
 from typing import Optional, Tuple
 
 import jax
@@ -49,13 +50,41 @@ class SelectAlgo(enum.Enum):
 
 def _choose_algo(batch: int, length: int, k: int) -> SelectAlgo:
     """Shape-bucketed dispatch (parity with the offline-trained decision tree
-    at ``detail/select_k-inl.cuh:40-64``).  Buckets re-tuned on TPU via
-    ``bench/tune_select_k.py``; conservative defaults here."""
+    at ``detail/select_k-inl.cuh:40-64``).  ``bench/tune_select_k.py``
+    regenerates the measured table; absent a table entry the default is
+    ``lax.top_k``, which measured within noise of the Pallas kernel at the
+    bench shapes (both latency-floored on the remote-TPU link)."""
     if k >= length:
         return SelectAlgo.kSortFull
-    if k <= 128 and length >= 4096:
-        return SelectAlgo.kPartialBitonic
+    entry = _tuned_entry(batch, length, k)
+    if entry is not None:
+        return SelectAlgo(entry)
     return SelectAlgo.kTopK
+
+
+@functools.lru_cache(maxsize=1)
+def _tuned_table():
+    """Measured dispatch table written by ``bench/tune_select_k.py`` —
+    the reference's offline-trained-heuristic pattern
+    (``cpp/scripts/heuristics/select_k``)."""
+    import json
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "_select_k_table.json")
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _tuned_entry(batch: int, length: int, k: int):
+    table = _tuned_table()
+    if not table:
+        return None
+    # bucket by log2 like the reference's decision tree features
+    key = f"{batch.bit_length()}:{length.bit_length()}:{k.bit_length()}"
+    return table.get(key)
 
 
 def select_k(

@@ -21,10 +21,14 @@ __all__ = ["brute_force", "knn"]
 
 
 def __getattr__(name):
-    if name in ("ivf_flat", "ivf_pq", "cagra", "refine"):
+    if name in ("ivf_flat", "ivf_pq", "cagra", "refine", "serialize"):
         import importlib
 
         mod = importlib.import_module(f"raft_tpu.neighbors.{name}")
         globals()[name] = mod
         return mod
+    if name in ("save_index", "load_index"):
+        from . import serialize as _ser
+
+        return getattr(_ser, name)
     raise AttributeError(f"module 'raft_tpu.neighbors' has no attribute {name!r}")

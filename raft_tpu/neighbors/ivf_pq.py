@@ -117,7 +117,10 @@ def _plain_kmeans(xs, key, k: int, iters: int):
     """Minimal Lloyd loop for codebook training (dedicated to keep
     _train_codebooks vmap-friendly; cluster.kmeans drives the coarse level)."""
     n = xs.shape[0]
-    idx = jax.random.choice(key, n, (k,), replace=False)
+    # small trainsets (< codebook size) seed with replacement: duplicate
+    # seeds merge over Lloyd iterations, matching the reference's behavior
+    # of tolerating n_train < 2^pq_bits
+    idx = jax.random.choice(key, n, (k,), replace=n < k)
     c0 = xs[idx]
 
     def body(c, _):

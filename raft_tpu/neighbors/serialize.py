@@ -1,0 +1,73 @@
+"""ANN index persistence — the checkpoint/resume story for the index
+family (SURVEY.md §5.4: the reference ships mdspan↔``.npy`` streams,
+``core/serialize.hpp:26,73``, which downstream libraries use for index
+save/load; here the same on-disk building block backs first-class
+``save_index``/``load_index``).
+
+Layout: one directory per index — a ``.npy`` file per array field plus a
+``meta.json`` carrying the index type, static fields, and a format
+version (``core.serialize.save_arrays``).  Everything is plain NumPy on
+disk: artifacts are portable, inspectable, and loadable without JAX.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Union
+
+import jax
+import numpy as np
+
+from ..core.serialize import load_arrays, save_arrays
+
+__all__ = ["save_index", "load_index"]
+
+_FORMAT_VERSION = 1
+
+
+def _index_registry():
+    from .cagra import CagraIndex, ShardedCagraIndex
+    from .ivf_flat import IvfFlatIndex
+    from .ivf_pq import IvfPqIndex
+
+    return {c.__name__: c for c in
+            (IvfFlatIndex, IvfPqIndex, CagraIndex, ShardedCagraIndex)}
+
+
+def save_index(path: Union[str, os.PathLike], index) -> None:
+    """Persist any of the ANN index dataclasses (IVF-Flat, IVF-PQ, CAGRA,
+    sharded CAGRA) to a directory of ``.npy`` files + JSON metadata."""
+    cls = type(index)
+    if cls.__name__ not in _index_registry():
+        raise TypeError(f"not a serializable index type: {cls.__name__}")
+    arrays, static = {}, {}
+    for f in dataclasses.fields(index):
+        v = getattr(index, f.name)
+        if isinstance(v, (jax.Array, np.ndarray)):
+            arrays[f.name] = np.asarray(v)
+        else:
+            static[f.name] = v
+    save_arrays(path, arrays, metadata={
+        "index_type": cls.__name__,
+        "format_version": _FORMAT_VERSION,
+        "static": static,
+    })
+
+
+def load_index(path: Union[str, os.PathLike], *, device: bool = True):
+    """Load an index saved by :func:`save_index`.  ``device=True`` places
+    array fields on the default device; ``device=False`` keeps NumPy
+    (useful to inspect or re-shard before transfer)."""
+    arrays, meta = load_arrays(path)
+    type_name = meta.get("index_type")
+    registry = _index_registry()
+    if type_name not in registry:
+        raise ValueError(f"{path!r}: unknown or missing index_type {type_name!r}")
+    if meta.get("format_version", 0) > _FORMAT_VERSION:
+        raise ValueError(f"{path!r}: format_version {meta['format_version']} "
+                         f"is newer than supported {_FORMAT_VERSION}")
+    fields = dict(meta.get("static", {}))
+    for name, arr in arrays.items():
+        fields[name] = jax.device_put(arr) if device else arr
+    return registry[type_name](**fields)

@@ -1,0 +1,41 @@
+"""Docstring examples are tested code — parity with pylibraft's
+``test_doctests.py`` (SURVEY.md §4: "docs are tested code"), which walks
+the public modules and executes every docstring example."""
+
+import doctest
+import importlib
+import pkgutil
+
+import numpy as np
+import pytest
+
+import raft_tpu
+
+# Modules whose import is cheap and whose docstrings may carry examples.
+# (Walking everything keeps new examples enrolled automatically.)
+
+
+def _iter_modules():
+    pkg = raft_tpu
+    names = ["raft_tpu"]
+    for m in pkgutil.walk_packages(pkg.__path__, prefix="raft_tpu."):
+        names.append(m.name)
+    return names
+
+
+@pytest.mark.parametrize("name", _iter_modules())
+def test_docstring_examples(name):
+    try:
+        mod = importlib.import_module(name)
+    except ImportError as e:
+        pytest.skip(f"{name}: {e}")
+    finder = doctest.DocTestFinder(exclude_empty=True)
+    runner = doctest.DocTestRunner(optionflags=doctest.ELLIPSIS
+                                   | doctest.NORMALIZE_WHITESPACE)
+    globs = {"np": np}
+    failures = 0
+    for test in finder.find(mod, mod.__name__):
+        test.globs.update(globs)
+        result = runner.run(test)
+        failures += result.failed
+    assert failures == 0, f"{failures} doctest failure(s) in {name}"

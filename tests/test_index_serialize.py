@@ -1,0 +1,79 @@
+"""Index persistence roundtrips (SURVEY.md §5.4 checkpoint/resume parity;
+search results must be identical after save → load)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.neighbors import load_index, save_index
+
+
+def _blobs(rng, n=400, d=16):
+    return (rng.normal(size=(n, d)) +
+            rng.integers(0, 4, size=(n, 1)) * 5.0).astype(np.float32)
+
+
+def test_ivf_flat_roundtrip(tmp_path, rng):
+    from raft_tpu.neighbors.ivf_flat import IvfFlatIndexParams, build, search
+
+    x = _blobs(rng)
+    idx = build(x, IvfFlatIndexParams(n_lists=8, kmeans_n_iters=4))
+    save_index(tmp_path / "ivf", idx)
+    idx2 = load_index(tmp_path / "ivf")
+    d1, i1 = search(idx, x[:10], 5)
+    d2, i2 = search(idx2, x[:10], 5)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+    np.testing.assert_allclose(np.asarray(d1), np.asarray(d2))
+    assert idx2.metric == idx.metric
+
+
+def test_ivf_pq_roundtrip(tmp_path, rng):
+    from raft_tpu.neighbors.ivf_pq import IvfPqIndexParams, build, search
+
+    x = _blobs(rng)
+    idx = build(x, IvfPqIndexParams(n_lists=8, pq_dim=4, kmeans_n_iters=4,
+                                    pq_kmeans_n_iters=4))
+    save_index(tmp_path / "pq", idx)
+    idx2 = load_index(tmp_path / "pq")
+    d1, i1 = search(idx, x[:10], 5)
+    d2, i2 = search(idx2, x[:10], 5)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+    np.testing.assert_allclose(np.asarray(d1), np.asarray(d2))
+
+
+def test_cagra_roundtrip(tmp_path, rng):
+    from raft_tpu.neighbors.cagra import CagraIndexParams, build, search
+
+    x = _blobs(rng, n=300)
+    idx = build(x, CagraIndexParams(graph_degree=8,
+                                    intermediate_graph_degree=16, n_routers=8))
+    save_index(tmp_path / "cagra", idx)
+    idx2 = load_index(tmp_path / "cagra")
+    d1, i1 = search(idx, x[:10], 5)
+    d2, i2 = search(idx2, x[:10], 5)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
+def test_load_host_only(tmp_path, rng):
+    from raft_tpu.neighbors.ivf_flat import IvfFlatIndexParams, build
+
+    x = _blobs(rng)
+    idx = build(x, IvfFlatIndexParams(n_lists=4, kmeans_n_iters=2))
+    save_index(tmp_path / "h", idx)
+    host_idx = load_index(tmp_path / "h", device=False)
+    assert isinstance(host_idx.centroids, np.ndarray)
+
+
+def test_reject_unknown_type(tmp_path):
+    with pytest.raises(TypeError):
+        save_index(tmp_path / "bad", object())
+
+
+def test_artifacts_are_plain_npy(tmp_path, rng):
+    from raft_tpu.neighbors.ivf_flat import IvfFlatIndexParams, build
+
+    x = _blobs(rng)
+    idx = build(x, IvfFlatIndexParams(n_lists=4, kmeans_n_iters=2))
+    save_index(tmp_path / "npy", idx)
+    # interop: plain numpy can read every array artifact
+    got = np.load(tmp_path / "npy" / "centroids.npy")
+    np.testing.assert_array_equal(got, np.asarray(idx.centroids))

@@ -1,0 +1,85 @@
+"""IO layer tests: native C++ reader vs NumPy references (SURVEY.md §4's
+kernel-vs-naive-host-reference pattern applied to the IO subsystem)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu import io as rio
+from raft_tpu.io import native
+
+
+def _write_vecs(path, mat, elem_dtype):
+    rows, dim = mat.shape
+    with open(path, "wb") as f:
+        for r in range(rows):
+            np.int32(dim).tofile(f)
+            mat[r].astype(elem_dtype).tofile(f)
+
+
+def test_native_builds():
+    # the toolchain is present in this environment, so the fast path must load
+    assert native.available()
+
+
+@pytest.mark.parametrize("ext,dtype", [(".fvecs", np.float32),
+                                       (".ivecs", np.int32),
+                                       (".bvecs", np.uint8)])
+def test_vecs_roundtrip(tmp_path, rng, ext, dtype):
+    mat = (rng.normal(size=(37, 12)) * 10).astype(dtype)
+    p = str(tmp_path / f"data{ext}")
+    _write_vecs(p, mat, dtype)
+    assert rio.vecs_shape(p) == (37, 12)
+    np.testing.assert_array_equal(rio.read_fvecs(p) if ext == ".fvecs"
+                                  else rio.read_ivecs(p) if ext == ".ivecs"
+                                  else rio.read_bvecs(p), mat)
+    # partial range
+    part = rio.read_fvecs(p, 5, 9) if ext == ".fvecs" else \
+        rio.read_ivecs(p, 5, 9) if ext == ".ivecs" else rio.read_bvecs(p, 5, 9)
+    np.testing.assert_array_equal(part, mat[5:14])
+
+
+def test_read_npy_native_matches_numpy(tmp_path, rng):
+    for arr in [rng.normal(size=(50, 7)).astype(np.float32),
+                (rng.normal(size=(3, 4, 5)) * 100).astype(np.int64),
+                rng.normal(size=(2049,)).astype(np.float64)]:
+        p = str(tmp_path / "a.npy")
+        np.save(p, arr)
+        np.testing.assert_array_equal(rio.read_npy(p), arr)
+        np.testing.assert_array_equal(rio.read_npy(p, mmap=True), arr)
+
+
+def test_npy_header_parse(tmp_path):
+    p = str(tmp_path / "h.npy")
+    np.save(p, np.zeros((6, 3), np.float32))
+    if not native.available():
+        pytest.skip("native lib unavailable")
+    descr, shape, fortran, off = native.npy_header(p)
+    assert descr == "<f4" and shape == (6, 3) and not fortran and off >= 64
+
+
+def test_batch_loader(tmp_path, rng):
+    mat = rng.normal(size=(100, 8)).astype(np.float32)
+    p = str(tmp_path / "d.fvecs")
+    _write_vecs(p, mat, np.float32)
+    loader = rio.BatchLoader(p, 32)
+    assert len(loader) == 4 and loader.dim == 8
+    batches = list(loader)
+    assert [b.shape[0] for b in batches] == [32, 32, 32, 4]
+    np.testing.assert_array_equal(np.concatenate(batches), mat)
+
+
+def test_vecs_out_of_range(tmp_path, rng):
+    mat = rng.normal(size=(10, 4)).astype(np.float32)
+    p = str(tmp_path / "d.fvecs")
+    _write_vecs(p, mat, np.float32)
+    with pytest.raises(ValueError):
+        rio.read_fvecs(p, 5, 100)
+
+
+def test_read_npy_structured_dtype_falls_back(tmp_path):
+    # the C parser can't express structured dtypes; read_npy must still load
+    arr = np.zeros(5, dtype=[("a", np.float32), ("b", np.int32)])
+    p = str(tmp_path / "s.npy")
+    np.save(p, arr)
+    got = rio.read_npy(p)
+    np.testing.assert_array_equal(got, arr)
