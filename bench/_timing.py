@@ -1,0 +1,34 @@
+"""Shared timing harness for the bench scripts.
+
+Min-of-N wall time with a host-fetch barrier after every call:
+``jax.block_until_ready`` returns at enqueue on the remote-TPU tunnel
+backend, so fetching (small) outputs is the only reliable sync — the
+same caveat bench.py documents.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import numpy as np
+
+__all__ = ["sync", "timeit"]
+
+
+def sync(out):
+    """Force completion by fetching every output leaf to host."""
+    for leaf in jax.tree_util.tree_leaves(out):
+        np.asarray(leaf)
+    return out
+
+
+def timeit(fn, reps: int = 3) -> float:
+    """Best-of-``reps`` seconds for ``fn()`` (one untimed warm-up/compile)."""
+    sync(fn())
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        sync(fn())
+        best = min(best, time.perf_counter() - t0)
+    return best

@@ -23,21 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-
-def _sync(out):
-    for leaf in jax.tree_util.tree_leaves(out):
-        np.asarray(leaf)
-    return out
-
-
-def _time(fn, reps=3):
-    _sync(fn())
-    ts = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        _sync(fn())
-        ts.append(time.perf_counter() - t0)
-    return min(ts)
+from _timing import timeit as _time
 
 
 def report(suite, case, seconds, items):

@@ -26,24 +26,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from _timing import timeit as _time
 from raft_tpu.matrix.select_k import SelectAlgo, select_k
 
 GRID_ROWS = [256, 2048, 16384]
 GRID_COLS = [1024, 16384, 131072]
 GRID_K = [8, 32, 128]
 CANDIDATES = [SelectAlgo.kTopK, SelectAlgo.kPartialBitonic, SelectAlgo.kBinSelect]
-
-
-def _time(fn, reps=3):
-    out = fn()
-    np.asarray(out[0])  # host fetch = only reliable barrier on the tunnel
-    ts = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        out = fn()
-        np.asarray(out[0])
-        ts.append(time.perf_counter() - t0)
-    return min(ts)
 
 
 def main() -> None:

@@ -30,6 +30,7 @@ from .comms import (
     barrier,
 )
 from .bootstrap import init_distributed, inject_comms_on_resources
+from .ring import ring_topk_merge
 from . import selftest
 
 __all__ = [
@@ -47,6 +48,7 @@ __all__ = [
     "alltoall",
     "sendrecv",
     "ring_shift",
+    "ring_topk_merge",
     "multicast_sendrecv",
     "barrier",
     "init_distributed",

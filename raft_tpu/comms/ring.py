@@ -1,0 +1,53 @@
+"""Ring pipelines over ICI — the candidate-exchange analog of ring
+attention (SURVEY.md §5.7: "ring-style ppermute/all_to_all pipelines over
+ICI for candidate exchange — the moral equivalent of ring attention
+applied to top-k merging").
+
+The all_gather merge (``neighbors.brute_force.knn_sharded``) materializes
+``S·k`` candidates per query on every shard before one wide select.  The
+ring formulation keeps memory constant: each of ``S−1`` steps ppermutes a
+``(m, k)`` buffer one hop around the ring and folds it into the running
+best via a ``2k``-wide merge — bandwidth-optimal on a torus ring, peak
+memory ``O(m·k)`` instead of ``O(m·S·k)``, and each hop's transfer
+overlaps the previous hop's merge under XLA's scheduler.
+
+Must be called inside ``shard_map`` over the named axis.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ring_topk_merge"]
+
+
+def ring_topk_merge(vals: jax.Array, idx: jax.Array, k: int, axis: str,
+                    *, select_min: bool = True) -> Tuple[jax.Array, jax.Array]:
+    """Global top-k across shards of per-shard ``(m, k)`` candidates.
+
+    Every shard circulates its candidate buffer around the ring; after
+    ``S−1`` hops each shard has folded every other shard's candidates into
+    its running best, so the result is replicated (exact merges are
+    order-independent).  ``vals`` must be min-ordered when ``select_min``
+    (negate beforehand otherwise).
+    """
+    size = jax.lax.axis_size(axis)
+    perm = [(j, (j + 1) % size) for j in range(size)]
+
+    def hop(carry, _):
+        best_v, best_i, cur_v, cur_i = carry
+        cur_v = jax.lax.ppermute(cur_v, axis, perm)
+        cur_i = jax.lax.ppermute(cur_i, axis, perm)
+        cat_v = jnp.concatenate([best_v, cur_v], axis=1)
+        cat_i = jnp.concatenate([best_i, cur_i], axis=1)
+        sign = 1.0 if select_min else -1.0
+        neg, pos = jax.lax.top_k(-sign * cat_v, k)
+        return (sign * -neg, jnp.take_along_axis(cat_i, pos, axis=1),
+                cur_v, cur_i), None
+
+    (best_v, best_i, _, _), _ = jax.lax.scan(
+        hop, (vals, idx, vals, idx), None, length=size - 1)
+    return best_v, best_i

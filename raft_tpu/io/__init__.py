@@ -49,6 +49,11 @@ def read_npy(path: str, *, mmap: bool = False, threads: int = 8) -> np.ndarray:
         return np.load(path, allow_pickle=False)
     descr, shape, fortran, offset = hdr
     dt = np.dtype(descr)
+    if dt.hasobject:
+        # object dtypes hold pickle bytes, not raw data — filling a
+        # PyObject* array from disk would segfault; np.load raises the
+        # proper allow_pickle error instead
+        return np.load(path, allow_pickle=False)
     out = np.empty(shape, dtype=dt, order="F" if fortran else "C")
     if not native.pread_dense_into(path, offset, out, threads=threads):
         return np.load(path, allow_pickle=False)
@@ -76,10 +81,10 @@ def _vecs_meta(path: str):
     return _VECS_DTYPES[ext]
 
 
-def _read_vecs(path: str, start: int, count: Optional[int],
-               threads: int) -> np.ndarray:
+def _read_vecs(path: str, start: int, count: Optional[int], threads: int,
+               geometry: Optional[Tuple[int, int]] = None) -> np.ndarray:
     dt, esz = _vecs_meta(path)
-    rows, dim = vecs_shape(path)
+    rows, dim = geometry if geometry is not None else vecs_shape(path)
     if count is None:
         count = rows - start
     if start < 0 or start + count > rows:
@@ -123,8 +128,8 @@ class BatchLoader:
                  stop: Optional[int] = None, threads: int = 8):
         self._path = path
         self._batch = int(batch_rows)
-        rows, self._dim = vecs_shape(path)
-        self._stop = rows if stop is None else min(stop, rows)
+        self._rows, self._dim = vecs_shape(path)
+        self._stop = self._rows if stop is None else min(stop, self._rows)
         self._start = start
         self._threads = threads
 
@@ -140,15 +145,18 @@ class BatchLoader:
 
         with cf.ThreadPoolExecutor(max_workers=1) as pool:
             nxt = None
+            geom = (self._rows, self._dim)
             for lo in range(self._start, self._stop, self._batch):
                 n = min(self._batch, self._stop - lo)
                 if nxt is None:
-                    nxt = pool.submit(_read_vecs, self._path, lo, n, self._threads)
+                    nxt = pool.submit(_read_vecs, self._path, lo, n,
+                                      self._threads, geom)
                 cur = nxt.result()
                 hi = lo + self._batch
                 if hi < self._stop:
                     nn = min(self._batch, self._stop - hi)
-                    nxt = pool.submit(_read_vecs, self._path, hi, nn, self._threads)
+                    nxt = pool.submit(_read_vecs, self._path, hi, nn,
+                                      self._threads, geom)
                 else:
                     nxt = None
                 yield cur

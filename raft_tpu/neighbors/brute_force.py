@@ -225,7 +225,8 @@ def knn(
 
 
 @functools.lru_cache(maxsize=64)
-def _sharded_knn_program(mesh: Mesh, axis: str, rows: int, k: int, kk: int, metric: str, tile: int):
+def _sharded_knn_program(mesh: Mesh, axis: str, rows: int, k: int, kk: int,
+                         metric: str, tile: int, merge: str):
     """Compile-once sharded search: jit keyed on the static config instead of
     a per-call closure (which would re-trace every knn_sharded call)."""
     nsh = mesh.shape[axis]
@@ -238,17 +239,30 @@ def _sharded_knn_program(mesh: Mesh, axis: str, rows: int, k: int, kk: int, metr
         if metric == "inner_product":
             v = -v  # back to smaller-is-nearer for the cross-shard merge
         gi = i + shard * rows
-        # gather all shards' candidates: (nsh, m, kk)
-        gv = jax.lax.all_gather(v, axis)
-        gidx = jax.lax.all_gather(gi, axis)
-        m = xq.shape[0]
-        gv = jnp.moveaxis(gv, 0, 1).reshape(m, nsh * kk)
-        gidx = jnp.moveaxis(gidx, 0, 1).reshape(m, nsh * kk)
-        neg, pos = jax.lax.top_k(-gv, k)
-        out_v = -neg
+        if merge == "ring":
+            # ppermute ring: constant memory, hop transfers overlap merges
+            from ..comms.ring import ring_topk_merge
+
+            pad = k - kk
+            if pad:  # ring buffers must already be (m, k)
+                v = jnp.concatenate(
+                    [v, jnp.full((v.shape[0], pad), jnp.inf, v.dtype)], axis=1)
+                gi = jnp.concatenate(
+                    [gi, jnp.full((gi.shape[0], pad), -1, gi.dtype)], axis=1)
+            out_v, out_i = ring_topk_merge(v, gi, k, axis)
+        else:
+            # all_gather everyone's candidates: (nsh, m, kk) → one wide select
+            gv = jax.lax.all_gather(v, axis)
+            gidx = jax.lax.all_gather(gi, axis)
+            m = xq.shape[0]
+            gv = jnp.moveaxis(gv, 0, 1).reshape(m, nsh * kk)
+            gidx = jnp.moveaxis(gidx, 0, 1).reshape(m, nsh * kk)
+            neg, pos = jax.lax.top_k(-gv, k)
+            out_v = -neg
+            out_i = jnp.take_along_axis(gidx, pos, axis=1)
         if metric == "inner_product":
             out_v = -out_v
-        return out_v, jnp.take_along_axis(gidx, pos, axis=1)
+        return out_v, out_i
 
     return jax.jit(
         jax.shard_map(
@@ -270,22 +284,29 @@ def knn_sharded(
     axis: str = "shard",
     metric: str = "sqeuclidean",
     tile: int = 8192,
+    merge: str = "gather",
 ) -> Tuple[jax.Array, jax.Array]:
     """Database-sharded exact kNN over a mesh axis.
 
-    Each device holds ``n/n_shards`` database rows (queries replicated),
-    computes a local top-k with *global* index numbering, then candidates are
-    gathered over ICI and merged.  One all_gather of (m, k) per shard — tiny
-    vs. the distance FLOPs, so this scales ~linearly until queries replicate
-    poorly.
+    Each device holds ``n/n_shards`` database rows (queries replicated) and
+    computes a local top-k with *global* index numbering; cross-shard merge
+    is either ``merge="gather"`` (one all_gather of every shard's (m, k),
+    then a wide select — lowest latency at small S·k) or ``merge="ring"``
+    (S−1 ppermute hops folding one neighbor's buffer at a time — constant
+    memory, transfers overlap merges; the ring-attention-style pipeline for
+    large k or many shards, :mod:`raft_tpu.comms.ring`).
     """
     x = wrap_array(queries, ndim=2, name="queries")
     y = wrap_array(database, ndim=2, name="database")
+    expects(merge in ("gather", "ring"), f"unknown merge {merge!r}")
+    expects(k >= 1, "k must be >= 1")
+    expects(k <= y.shape[0], f"k={k} exceeds database size {y.shape[0]}")
     nsh = mesh.shape[axis]
     n = y.shape[0]
     expects(n % nsh == 0, f"database rows {n} not divisible by mesh axis {nsh}")
     rows = n // nsh
     kk = min(k, rows)
-    fn = _sharded_knn_program(mesh, axis, rows, int(k), kk, metric, int(min(tile, rows)))
+    fn = _sharded_knn_program(mesh, axis, rows, int(k), kk, metric,
+                              int(min(tile, rows)), merge)
     yb = y.reshape(nsh, rows, y.shape[1])
     return fn(x, yb)

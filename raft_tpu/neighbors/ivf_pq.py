@@ -39,6 +39,8 @@ __all__ = [
     "IvfPqIndex",
     "build",
     "search",
+    "build_sharded",
+    "search_sharded",
 ]
 
 
@@ -277,3 +279,84 @@ def search(index: IvfPqIndex, queries, k: int,
     return _search_impl(index.centroids, index.codebooks, index.codes,
                         index.code_norms, index.ids, index.counts, q,
                         int(k), int(n_probes), index.metric)
+
+
+# ---------------------------------------------------------------------------
+# Sharded (multi-chip) variant: lists partitioned over the mesh axis,
+# codebooks replicated (they are tiny: m * 2^bits * ds floats).
+# Mirrors ivf_flat.build_sharded/search_sharded; the TPU analog of the
+# reference's MNMG rank-sharded indexes over comms_t (SURVEY.md §5.7).
+# ---------------------------------------------------------------------------
+
+
+def build_sharded(dataset, mesh, params: Optional[IvfPqIndexParams] = None,
+                  *, axis: str = "shard") -> IvfPqIndex:
+    """Build with ``n_lists`` padded to the axis size; list slabs laid out
+    shard-major so device d owns lists [d*L/n, (d+1)*L/n)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    p = params or IvfPqIndexParams()
+    n_dev = int(mesh.shape[axis])
+    n_lists = ((p.n_lists + n_dev - 1) // n_dev) * n_dev
+    p = dataclasses.replace(p, n_lists=n_lists)
+    index = build(dataset, p)
+    shard = NamedSharding(mesh, P(axis))
+    replicated = NamedSharding(mesh, P())
+    return IvfPqIndex(
+        jax.device_put(index.centroids, shard),
+        jax.device_put(index.codebooks, replicated),
+        jax.device_put(index.codes, shard),
+        jax.device_put(index.code_norms, shard),
+        jax.device_put(index.ids, shard),
+        jax.device_put(index.counts, shard),
+        index.metric,
+    )
+
+
+@partial(jax.jit, static_argnames=("k", "n_probes", "metric", "axis", "mesh"))
+def _search_sharded_impl(mesh, axis, centroids, codebooks, codes, code_norms,
+                         ids, counts, q, k: int, n_probes: int, metric: str):
+    from jax.sharding import PartitionSpec as P
+
+    def local(centroids_l, codebooks_l, codes_l, code_norms_l, ids_l,
+              counts_l, q_l):
+        bv, bi = _search_impl(centroids_l, codebooks_l, codes_l, code_norms_l,
+                              ids_l, counts_l, q_l, k, n_probes, metric)
+        if metric == "inner_product":
+            bv = -bv  # back to min-selectable for the cross-shard merge
+        av = jax.lax.all_gather(bv, axis, tiled=False)   # [S, nq, k]
+        ai = jax.lax.all_gather(bi, axis, tiled=False)
+        av = jnp.moveaxis(av, 0, 1).reshape(q_l.shape[0], -1)
+        ai = jnp.moveaxis(ai, 0, 1).reshape(q_l.shape[0], -1)
+        from ..matrix.select_k import select_k
+
+        fv, fi = select_k(av, k, in_idx=ai, select_min=True)
+        if metric == "inner_product":
+            fv = -fv
+        return fv, fi
+
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axis), P(), P(axis), P(axis), P(axis), P(axis), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )(centroids, codebooks, codes, code_norms, ids, counts, q)
+
+
+def search_sharded(index: IvfPqIndex, queries, k: int,
+                   params: Optional[IvfPqSearchParams] = None, *,
+                   mesh, axis: str = "shard") -> Tuple[jax.Array, jax.Array]:
+    """Multi-chip ADC search: each shard probes its ``n_probes`` nearest
+    *local* lists (union over shards covers the globally nearest lists),
+    one all_gather of (nq, k) candidates merges over ICI."""
+    p = params or IvfPqSearchParams()
+    q = wrap_array(queries, ndim=2, name="queries")
+    expects(q.shape[1] == index.dim, "query dim mismatch")
+    n_dev = int(mesh.shape[axis])
+    local_lists = index.n_lists // n_dev
+    n_probes = min(p.n_probes, local_lists)
+    return _search_sharded_impl(mesh, axis, index.centroids, index.codebooks,
+                                index.codes, index.code_norms, index.ids,
+                                index.counts, q, int(k), int(n_probes),
+                                index.metric)

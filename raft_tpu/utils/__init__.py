@@ -1,0 +1,34 @@
+"""Host/device utility layer — the portable subset of
+``cpp/include/raft/util`` (SURVEY.md §2.2).
+
+Most of the reference's util layer is CUDA mechanics (warp shuffles,
+cache-hinted loads, SM-arch dispatch) that has no TPU counterpart — XLA
+and Mosaic own those decisions.  What transplants is the *host-side*
+toolbox: power-of-two arithmetic (``util/pow2_utils.cuh``,
+``util/integer_utils.hpp``), the prime seive (``util/seive.hpp``),
+itertools helpers (``util/itertools.hpp``), dtype mapping
+(``util/cuda_data_type.hpp`` → canonical JAX dtypes), and input
+validation (``util/input_validation.hpp``).
+"""
+
+from .math import (
+    bounded,
+    ceildiv,
+    is_pow2,
+    next_pow2,
+    prev_pow2,
+    round_down_safe,
+    round_up_safe,
+)
+from .seive import Seive, primes_up_to
+from .itertools import product_of
+from .dtype import canonical_dtype, dtype_code
+from .validation import check_contiguous, check_finite
+
+__all__ = [
+    "ceildiv", "is_pow2", "next_pow2", "prev_pow2", "round_up_safe",
+    "round_down_safe", "bounded",
+    "Seive", "primes_up_to", "product_of",
+    "canonical_dtype", "dtype_code",
+    "check_contiguous", "check_finite",
+]

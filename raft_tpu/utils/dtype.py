@@ -1,0 +1,40 @@
+"""Dtype mapping — ``util/cuda_data_type.hpp`` parity: the reference maps
+C++ types ↔ ``cudaDataType_t`` for vendor-library calls; here the mapping
+is arbitrary array-likes ↔ canonical JAX dtypes (+ short wire codes used
+by the IO layer)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+__all__ = ["canonical_dtype", "dtype_code"]
+
+_CODES = {
+    "float32": "f4", "float64": "f8", "float16": "f2", "bfloat16": "bf16",
+    "int8": "i1", "int16": "i2", "int32": "i4", "int64": "i8",
+    "uint8": "u1", "uint16": "u2", "uint32": "u4", "uint64": "u8",
+    "bool": "b1",
+}
+
+
+def canonical_dtype(x: Any) -> np.dtype:
+    """The JAX-canonical dtype for a value, dtype, or dtype name (respects
+    x64 being disabled: float64 → float32, like the device-side promotion)."""
+    if hasattr(x, "dtype"):
+        x = x.dtype
+    return np.dtype(jax.dtypes.canonicalize_dtype(np.dtype(x)))
+
+
+def dtype_code(x: Any) -> str:
+    """Short wire code for a dtype (``cudaDataType_t`` analog)."""
+    if not isinstance(x, type) and hasattr(x, "dtype"):  # array-like instance
+        dt = np.dtype(x.dtype)
+    else:  # dtype object, scalar type, or name
+        dt = np.dtype(x)
+    try:
+        return _CODES[dt.name]
+    except KeyError:
+        raise ValueError(f"no wire code for dtype {dt.name!r}") from None

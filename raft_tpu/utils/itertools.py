@@ -1,0 +1,21 @@
+"""Test/bench parameter-grid helpers — ``util/itertools.hpp`` parity
+(the reference uses it to enumerate test-case structs from value lists)."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, Iterable, List
+
+__all__ = ["product_of"]
+
+
+def product_of(**axes: Iterable[Any]) -> List[Dict[str, Any]]:
+    """Cartesian product of named value lists as a list of dicts.
+
+    >>> cases = product_of(rows=[1, 2], k=[10])
+    >>> cases == [{"rows": 1, "k": 10}, {"rows": 2, "k": 10}]
+    True
+    """
+    names = list(axes)
+    return [dict(zip(names, combo))
+            for combo in itertools.product(*(list(axes[n]) for n in names))]

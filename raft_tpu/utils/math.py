@@ -1,0 +1,41 @@
+"""Integer / power-of-two arithmetic — ``util/pow2_utils.cuh``,
+``util/integer_utils.hpp`` parity (host-side: on device XLA constant-folds
+these when shapes are static)."""
+
+from __future__ import annotations
+
+__all__ = ["ceildiv", "is_pow2", "next_pow2", "prev_pow2",
+           "round_up_safe", "round_down_safe", "bounded"]
+
+
+def ceildiv(a: int, b: int) -> int:
+    """⌈a/b⌉ for non-negative ints (``raft::ceildiv``)."""
+    return -(-a // b)
+
+
+def is_pow2(x: int) -> bool:
+    return x > 0 and (x & (x - 1)) == 0
+
+
+def next_pow2(x: int) -> int:
+    """Smallest power of two ≥ x (x ≥ 1)."""
+    return 1 if x <= 1 else 1 << (x - 1).bit_length()
+
+
+def prev_pow2(x: int) -> int:
+    """Largest power of two ≤ x (x ≥ 1)."""
+    return 1 << (x.bit_length() - 1)
+
+
+def round_up_safe(x: int, multiple: int) -> int:
+    """x rounded up to a multiple (``raft::round_up_safe``)."""
+    return ceildiv(x, multiple) * multiple
+
+
+def round_down_safe(x: int, multiple: int) -> int:
+    return (x // multiple) * multiple
+
+
+def bounded(x, lo, hi):
+    """Clamp (``raft::bounded``-style helper)."""
+    return max(lo, min(hi, x))

@@ -1,0 +1,34 @@
+"""Prime seive — ``util/seive.hpp`` parity (Eratosthenes; the reference
+uses it for hashing-related sizing downstream)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Seive", "primes_up_to"]
+
+
+def primes_up_to(n: int) -> np.ndarray:
+    """All primes ≤ n, vectorized Eratosthenes."""
+    if n < 2:
+        return np.empty(0, np.int64)
+    mask = np.ones(n + 1, bool)
+    mask[:2] = False
+    for p in range(2, int(n ** 0.5) + 1):
+        if mask[p]:
+            mask[p * p:: p] = False
+    return np.flatnonzero(mask).astype(np.int64)
+
+
+class Seive:
+    """Query object over a precomputed seive (``raft::common::Seive``)."""
+
+    def __init__(self, n: int):
+        self._n = n
+        self._mask = np.zeros(n + 1, bool)
+        self._mask[primes_up_to(n)] = True
+
+    def is_prime(self, x: int) -> bool:
+        if not 0 <= x <= self._n:
+            raise ValueError(f"{x} outside seive range [0, {self._n}]")
+        return bool(self._mask[x])

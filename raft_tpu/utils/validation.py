@@ -1,0 +1,29 @@
+"""Input validation — ``util/input_validation.hpp`` parity (the reference
+checks mdspan layout/exhaustiveness; here: contiguity and finiteness of
+host inputs before they enter jitted programs)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from ..core.errors import expects
+
+__all__ = ["check_contiguous", "check_finite"]
+
+
+def check_contiguous(x: Any, name: str = "array") -> None:
+    """Reject non-contiguous host arrays (``is_row_major`` analog — device
+    transfer of strided views silently copies; make the caller opt in)."""
+    if isinstance(x, np.ndarray):
+        expects(x.flags["C_CONTIGUOUS"] or x.flags["F_CONTIGUOUS"],
+                f"{name} must be contiguous (got strides {x.strides})")
+
+
+def check_finite(x: Any, name: str = "array") -> None:
+    """Reject NaN/Inf in host inputs (cheap guard for build-time paths that
+    would otherwise poison kmeans/top-k silently)."""
+    arr = np.asarray(x)
+    if arr.dtype.kind == "f":
+        expects(bool(np.isfinite(arr).all()), f"{name} contains NaN/Inf")

@@ -100,3 +100,29 @@ def test_knn_fast_mode(rng, metric):
     np.testing.assert_allclose(np.sort(np.asarray(d), axis=1),
                                np.sort(np.asarray(d_ref), axis=1)[:, :5],
                                rtol=2e-2, atol=2e-2)
+
+
+def test_knn_sharded_ring_matches_gather(rng, mesh8):
+    x = rng.standard_normal((10, 8)).astype(np.float32)
+    y = rng.standard_normal((160, 8)).astype(np.float32)
+    d_g, i_g = knn_sharded(x, y, 5, mesh=mesh8, merge="gather")
+    d_r, i_r = knn_sharded(x, y, 5, mesh=mesh8, merge="ring")
+    np.testing.assert_allclose(np.asarray(d_r), np.asarray(d_g), rtol=1e-5)
+    np.testing.assert_array_equal(np.asarray(i_r), np.asarray(i_g))
+
+
+def test_knn_sharded_ring_inner_product(rng, mesh8):
+    x = rng.standard_normal((6, 5)).astype(np.float32)
+    y = rng.standard_normal((80, 5)).astype(np.float32)
+    d_ref, i_ref = knn(x, y, 3, metric="inner_product")
+    d, i = knn_sharded(x, y, 3, mesh=mesh8, metric="inner_product", merge="ring")
+    np.testing.assert_allclose(np.asarray(d), np.asarray(d_ref), rtol=1e-4, atol=1e-4)
+
+
+def test_knn_sharded_ring_k_exceeds_rows(rng, mesh8):
+    # per-shard rows (2) < k (5): ring buffers must pad correctly
+    x = rng.standard_normal((4, 6)).astype(np.float32)
+    y = rng.standard_normal((16, 6)).astype(np.float32)
+    d_ref, i_ref = knn(x, y, 5)
+    d, i = knn_sharded(x, y, 5, mesh=mesh8, merge="ring")
+    np.testing.assert_allclose(np.asarray(d), np.asarray(d_ref), rtol=1e-5)

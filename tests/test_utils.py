@@ -1,0 +1,55 @@
+"""Utility-layer tests (SURVEY.md §2.2 portable subset)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.utils import (Seive, bounded, canonical_dtype, ceildiv,
+                            check_contiguous, check_finite, dtype_code,
+                            is_pow2, next_pow2, prev_pow2, primes_up_to,
+                            product_of, round_down_safe, round_up_safe)
+
+
+def test_pow2_family():
+    assert ceildiv(10, 3) == 4 and ceildiv(9, 3) == 3 and ceildiv(0, 5) == 0
+    assert is_pow2(1) and is_pow2(1024)
+    assert not is_pow2(0) and not is_pow2(12)
+    assert next_pow2(1) == 1 and next_pow2(5) == 8 and next_pow2(1024) == 1024
+    assert prev_pow2(5) == 4 and prev_pow2(1024) == 1024
+    assert round_up_safe(10, 8) == 16 and round_down_safe(10, 8) == 8
+    assert bounded(5, 0, 3) == 3 and bounded(-1, 0, 3) == 0
+
+
+def test_seive():
+    np.testing.assert_array_equal(primes_up_to(20),
+                                  [2, 3, 5, 7, 11, 13, 17, 19])
+    s = Seive(100)
+    assert s.is_prime(97) and not s.is_prime(91)
+    with pytest.raises(ValueError):
+        s.is_prime(101)
+
+
+def test_product_of():
+    cases = product_of(rows=[1, 2], cols=[3], k=[4, 5])
+    assert len(cases) == 4
+    assert {"rows": 2, "cols": 3, "k": 5} in cases
+
+
+def test_dtype_mapping():
+    assert canonical_dtype(np.zeros(2, np.float64)) == np.float32  # x64 off
+    assert canonical_dtype("int32") == np.int32
+    assert dtype_code(np.float32) == "f4"
+    assert dtype_code(np.zeros(1, np.uint8)) == "u1"
+    with pytest.raises(ValueError):
+        dtype_code(np.dtype([("a", np.int32)]))
+
+
+def test_validation():
+    from raft_tpu.core.errors import LogicError
+
+    check_contiguous(np.zeros((4, 4)))
+    with pytest.raises(LogicError):
+        check_contiguous(np.zeros((8, 8))[::2, ::2])
+    check_finite(np.ones(3))
+    with pytest.raises(LogicError):
+        check_finite(np.array([1.0, np.nan]))
+    check_finite(np.array([1, 2, 3]))  # ints pass trivially
