@@ -100,6 +100,10 @@ int rt_npy_header(const char* path, char* descr, int descr_cap, int* ndim,
     shape[nd++] = v;
     s = end;
   }
+  // Unconsumed digits mean the tuple has more than 8 dims: error out so the
+  // caller falls back to np.load instead of a silently truncated shape.
+  while (*s == ' ' || *s == ',') ++s;
+  if (*s) return -EINVAL;
   *ndim = nd;
   return 0;
 }

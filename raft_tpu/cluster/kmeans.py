@@ -31,6 +31,7 @@ from ..core.array import wrap_array
 from ..core.errors import expects
 from ..distance.fused import _fused_l2_nn
 from ..distance.pairwise import sq_l2
+from ..utils.segment import within_group_rank as _within_group_rank
 
 __all__ = [
     "KMeansParams",
@@ -254,16 +255,6 @@ def _assign_balanced(x, c, counts, penalty, n_per):
     labels = jnp.argmin(cost, axis=1)
     real = jnp.take_along_axis(d2, labels[:, None], axis=1)[:, 0]
     return labels, real
-
-
-def _within_group_rank(groups, scores, k: int):
-    """Rank of each element among its group, ordered by ascending score."""
-    n = groups.shape[0]
-    perm = jnp.lexsort((scores, groups))
-    counts = jax.ops.segment_sum(jnp.ones((n,), jnp.int32), groups, num_segments=k)
-    starts = jnp.cumsum(counts) - counts
-    rank_sorted = jnp.arange(n, dtype=jnp.int32) - starts[groups[perm]]
-    return jnp.zeros((n,), jnp.int32).at[perm].set(rank_sorted)
 
 
 @partial(jax.jit, static_argnames=("cap",))

@@ -122,6 +122,23 @@ class MemoryTracker:
 
     @property
     def peak_bytes(self) -> Optional[int]:
-        """Allocator peak inside the scope, when the backend reports it."""
+        """Peak allocation attributable to this scope, when the backend
+        reports allocator statistics.
+
+        ``peak_bytes_in_use`` is a process-lifetime high-water mark, so a
+        peak reached *before* the scope would otherwise be reported
+        unchanged.  Subtracting the bytes already in use at entry bounds the
+        value to growth the scope could have caused; when the lifetime peak
+        predates the scope entirely the result is clamped to the scope's
+        live-byte growth (≥ 0).
+        """
         peak = self.end_stats.get("peak_bytes_in_use")
-        return int(peak) if peak is not None else None
+        if peak is None:
+            return None
+        start_in_use = self.start_stats.get("bytes_in_use")
+        start_peak = self.start_stats.get("peak_bytes_in_use")
+        if start_in_use is None or start_peak is None:
+            return int(peak)
+        if int(peak) <= int(start_peak):  # peak predates the scope
+            return max(self.allocated_delta, 0)
+        return int(peak) - int(start_in_use)

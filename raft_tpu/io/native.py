@@ -29,7 +29,18 @@ def _load() -> Optional[ctypes.CDLL]:
     path = os.path.join(here, _LIB_NAME)
     if not os.path.exists(path):
         cpp = os.path.join(here, "..", "..", "cpp")
-        if os.path.exists(os.path.join(cpp, "Makefile")):
+        # Auto-building on first IO call is surprising in library code
+        # (sandboxes pay a doomed subprocess attempt); opt out with
+        # RAFT_TPU_BUILD_NATIVE=0.  The attempt happens at most once per
+        # process (guarded by _tried) with a short timeout, and only when a
+        # toolchain is plausibly present.
+        import shutil
+
+        want_build = os.environ.get("RAFT_TPU_BUILD_NATIVE", "1") != "0"
+        cxx = os.environ.get("CXX", "g++")  # the Makefile honors $CXX
+        have_cxx = shutil.which(cxx) or shutil.which("g++") or shutil.which("clang++")
+        if (want_build and os.path.exists(os.path.join(cpp, "Makefile"))
+                and shutil.which("make") and have_cxx):
             # serialize concurrent builders (pytest-xdist, parallel jobs):
             # only the flock holder runs make; losers wait, then re-check
             try:
@@ -39,7 +50,7 @@ def _load() -> Optional[ctypes.CDLL]:
                     fcntl.flock(lk, fcntl.LOCK_EX)
                     if not os.path.exists(path):
                         subprocess.run(["make", "-C", cpp], capture_output=True,
-                                       timeout=120, check=True)
+                                       timeout=60, check=True)
             except (OSError, subprocess.SubprocessError, ImportError):
                 return None
     if not os.path.exists(path):

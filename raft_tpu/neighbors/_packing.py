@@ -1,0 +1,87 @@
+"""Device-side inverted-list packing shared by IVF-Flat and IVF-PQ builds.
+
+The round-1 builds scattered rows into the padded ``[n_lists, cap]`` slabs
+with host numpy (``ivf_flat.py:98`` r1) — fine at 10⁴ rows, hopeless at
+10⁷⁺.  This is the jitted replacement: one stable device sort by list id
+turns the scatter into a dense segment layout, and a single ``.at[].set``
+with out-of-bounds drop does the packing.  Everything stays on device; a
+10M-row build never round-trips through the host.
+
+Reference analog: the list-packing step of the cuVS IVF builds (no in-tree
+ancestor, SURVEY.md scope note); the sort-based formulation is the TPU
+replacement for CUDA atomic-append list construction.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["pack_lists", "chunked_queries"]
+
+
+def chunked_queries(run, q, chunk: int):
+    """Apply ``run(q_chunk) -> (vals, idx)`` over fixed-size query chunks
+    (pads the tail chunk so only one program is compiled); bounds the
+    per-dispatch gather working set of the IVF search paths."""
+    nq = q.shape[0]
+    if chunk <= 0 or nq <= chunk:
+        return run(q)
+    pad = (-nq) % chunk
+    qp = jnp.concatenate([q, jnp.tile(q[:1], (pad, 1))], axis=0) if pad else q
+    vals, idxs = [], []
+    for i in range(qp.shape[0] // chunk):
+        v, ix = run(qp[i * chunk:(i + 1) * chunk])
+        vals.append(v)
+        idxs.append(ix)
+    return (jnp.concatenate(vals, axis=0)[:nq],
+            jnp.concatenate(idxs, axis=0)[:nq])
+
+
+@partial(jax.jit, static_argnames=("n_lists", "cap", "fills"))
+def pack_lists(
+    labels: jax.Array,
+    arrays: Tuple[jax.Array, ...],
+    *,
+    n_lists: int,
+    cap: int,
+    fills: Tuple[float, ...],
+) -> Tuple[Tuple[jax.Array, ...], jax.Array]:
+    """Pack per-row payloads into padded per-list slabs, on device.
+
+    ``labels``: (n,) int32 list assignment, −1 = drop the row.
+    ``arrays``: tuple of payloads with leading dim n (e.g. vectors, ids).
+    ``fills``: pad value per payload (static, e.g. ``(0.0, -1)``).
+
+    Returns ``(packed, counts)`` where ``packed[i]`` has shape
+    ``(n_lists, cap, *arrays[i].shape[1:])`` and ``counts`` is (n_lists,)
+    int32 clamped to ``cap``.  Rows beyond a list's capacity are dropped
+    (callers using :func:`raft_tpu.cluster.kmeans.capped_assign` never hit
+    this).
+    """
+    n = labels.shape[0]
+    labels = labels.astype(jnp.int32)
+    valid = labels >= 0
+    # stable sort by list id; dropped rows sort to the end
+    sort_key = jnp.where(valid, labels, n_lists)
+    order = jnp.argsort(sort_key, stable=True)
+    sl = labels[order]
+    svalid = sl >= 0
+    sl_safe = jnp.where(svalid, sl, 0)
+    counts = jax.ops.segment_sum(
+        svalid.astype(jnp.int32), sl_safe, num_segments=n_lists
+    )
+    starts = jnp.cumsum(counts) - counts
+    pos = jnp.arange(n, dtype=jnp.int32) - starts[sl_safe]
+    ok = svalid & (pos < cap)
+    # out-of-range destination rows are dropped by scatter mode="drop"
+    dest = jnp.where(ok, sl_safe * cap + pos, n_lists * cap)
+    packed = []
+    for arr, fill in zip(arrays, fills):
+        flat = jnp.full((n_lists * cap,) + arr.shape[1:], fill, arr.dtype)
+        flat = flat.at[dest].set(arr[order], mode="drop")
+        packed.append(flat.reshape((n_lists, cap) + arr.shape[1:]))
+    return tuple(packed), jnp.minimum(counts, cap)

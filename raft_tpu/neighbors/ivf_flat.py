@@ -66,6 +66,7 @@ class IvfFlatIndexParams:
 @dataclasses.dataclass(frozen=True)
 class IvfFlatSearchParams:
     n_probes: int = 32
+    query_chunk: int = 4096  # cap on the [chunk, cap, d] gather working set
 
 
 @jax.tree_util.register_dataclass
@@ -95,31 +96,10 @@ class IvfFlatIndex:
         return int(jnp.sum(self.counts))
 
 
-def _pack_lists(dataset: np.ndarray, ids: np.ndarray, labels: np.ndarray,
-                n_lists: int, cap: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Scatter rows into the dense padded list slab (host-side build step)."""
-    n, d = dataset.shape
-    data = np.zeros((n_lists, cap, d), dataset.dtype)
-    out_ids = np.full((n_lists, cap), -1, np.int32)
-    # vectorized scatter: sort by list, position = rank within the list
-    keep = labels >= 0
-    order = np.argsort(labels[keep] if keep.all() else
-                       np.where(keep, labels, n_lists), kind="stable")
-    order = order[: int(keep.sum())]
-    sl = labels[order]
-    counts = np.bincount(sl, minlength=n_lists).astype(np.int32)
-    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
-    pos = np.arange(order.shape[0]) - starts[sl]
-    ok = pos < cap  # capped_assign guarantees this; belt and braces
-    data[sl[ok], pos[ok]] = dataset[order[ok]]
-    out_ids[sl[ok], pos[ok]] = ids[order[ok]]
-    counts = np.minimum(counts, cap)
-    return data, out_ids, counts
-
-
 def build(dataset, params: Optional[IvfFlatIndexParams] = None, *,
           source_ids=None, res=None) -> IvfFlatIndex:
-    """Train the coarse quantizer and pack inverted lists."""
+    """Train the coarse quantizer and pack inverted lists (all on device —
+    the packing is one jitted sort+scatter, :mod:`._packing`)."""
     p = params or IvfFlatIndexParams()
     x = wrap_array(dataset, ndim=2, name="dataset")
     n, d = x.shape
@@ -139,49 +119,57 @@ def build(dataset, params: Optional[IvfFlatIndexParams] = None, *,
     # 2. capacity-constrained assignment of the full dataset
     labels, _ = capped_assign(x, centroids, cap)
 
-    # 3. pack lists (host scatter — build is host-driven like the reference's)
-    ids = (np.asarray(source_ids, np.int32) if source_ids is not None
-           else np.arange(n, dtype=np.int32))
-    data, out_ids, counts = _pack_lists(np.asarray(x), ids,
-                                        np.asarray(labels), p.n_lists, cap)
-    data_j = jnp.asarray(data)
-    norms = jnp.sum(data_j.astype(jnp.float32) ** 2, axis=2)
-    return IvfFlatIndex(centroids, data_j, jnp.asarray(out_ids),
-                        jnp.asarray(counts), norms, p.metric)
+    # 3. pack lists — jitted sort+scatter, no host round-trip
+    from ._packing import pack_lists
+
+    ids = (jnp.asarray(source_ids, jnp.int32) if source_ids is not None
+           else jnp.arange(n, dtype=jnp.int32))
+    (data, out_ids), counts = pack_lists(
+        labels, (x, ids), n_lists=p.n_lists, cap=cap, fills=(0.0, -1))
+    norms = jnp.sum(data.astype(jnp.float32) ** 2, axis=2)
+    return IvfFlatIndex(centroids, data, out_ids, counts, norms, p.metric)
 
 
 def extend(index: IvfFlatIndex, new_vectors, new_ids=None) -> IvfFlatIndex:
-    """Append vectors to existing lists (host-eager, like cuVS extend).
+    """Append vectors to existing lists (device-side, like cuVS extend).
 
     The list slab is a static shape, so capacity grows when the new rows
     overflow it (rebuild-the-slab, the padded-layout price of extend).
     """
-    x = np.asarray(wrap_array(new_vectors, ndim=2))
-    ids = (np.asarray(new_ids, np.int32) if new_ids is not None
-           else np.arange(index.size, index.size + x.shape[0], dtype=np.int32))
-    labels = np.asarray(jnp.argmin(sq_l2(jnp.asarray(x), index.centroids), axis=1))
-    old_counts = np.asarray(index.counts)
-    added = np.bincount(labels, minlength=index.n_lists)
-    new_cap = max(index.list_cap, int((old_counts + added).max()))
+    from ._packing import pack_lists
 
-    n_lists, d = index.n_lists, index.dim
-    data = np.zeros((n_lists, new_cap, d), np.asarray(index.data).dtype)
-    out_ids = np.full((n_lists, new_cap), -1, np.int32)
-    data[:, : index.list_cap] = np.asarray(index.data)
-    out_ids[:, : index.list_cap] = np.asarray(index.ids)
+    x = wrap_array(new_vectors, ndim=2)
+    ids = (jnp.asarray(new_ids, jnp.int32) if new_ids is not None
+           else jnp.arange(index.size, index.size + x.shape[0], dtype=jnp.int32))
+    labels = jnp.argmin(sq_l2(x, index.centroids), axis=1).astype(jnp.int32)
+    added = jax.ops.segment_sum(
+        jnp.ones_like(labels), labels, num_segments=index.n_lists)
+    new_cap = max(index.list_cap, int(jnp.max(index.counts + added)))
 
-    order = np.argsort(labels, kind="stable")
-    sl = labels[order]
-    starts = np.concatenate([[0], np.cumsum(added)[:-1]])
-    pos = old_counts[sl] + (np.arange(order.shape[0]) - starts[sl])
-    data[sl, pos] = x[order]
-    out_ids[sl, pos] = ids[order]
-    counts = (old_counts + added).astype(np.int32)
-
-    data_j = jnp.asarray(data)
-    norms = jnp.sum(data_j.astype(jnp.float32) ** 2, axis=2)
-    return IvfFlatIndex(index.centroids, data_j, jnp.asarray(out_ids),
-                        jnp.asarray(counts), norms, index.metric)
+    # pack the new rows into their own slab, then splice after the old rows
+    (nd, nids), ncounts = pack_lists(
+        labels, (x.astype(index.data.dtype), ids),
+        n_lists=index.n_lists, cap=new_cap, fills=(0.0, -1))
+    pad = new_cap - index.list_cap
+    data = jnp.concatenate(
+        [index.data, jnp.zeros((index.n_lists, pad, index.dim), index.data.dtype)],
+        axis=1) if pad else index.data
+    out_ids = jnp.concatenate(
+        [index.ids, jnp.full((index.n_lists, pad), -1, jnp.int32)], axis=1
+    ) if pad else index.ids
+    # shift each list's new rows to start at the old count: roll via gather
+    col = jnp.arange(new_cap)[None, :]
+    src = col - index.counts[:, None]           # position in the new slab
+    take = (src >= 0) & (src < ncounts[:, None])
+    src_safe = jnp.clip(src, 0, new_cap - 1)
+    nd_shift = jnp.take_along_axis(nd, src_safe[:, :, None], axis=1)
+    nids_shift = jnp.take_along_axis(nids, src_safe, axis=1)
+    data = jnp.where(take[:, :, None], nd_shift, data)
+    out_ids = jnp.where(take, nids_shift, out_ids)
+    counts = (index.counts + ncounts).astype(jnp.int32)
+    norms = jnp.sum(data.astype(jnp.float32) ** 2, axis=2)
+    return IvfFlatIndex(index.centroids, data, out_ids, counts, norms,
+                        index.metric)
 
 
 def _probe_scan(q, qn, data, ids, counts, norms, probes, k: int, metric: str):
@@ -243,8 +231,12 @@ def search(index: IvfFlatIndex, queries, k: int,
     q = wrap_array(queries, ndim=2, name="queries")
     expects(q.shape[1] == index.dim, "query dim mismatch")
     n_probes = min(p.n_probes, index.n_lists)
-    return _search_impl(index.centroids, index.data, index.ids, index.counts,
-                        index.norms, q, int(k), int(n_probes), index.metric)
+    from ._packing import chunked_queries
+
+    run = lambda qc: _search_impl(index.centroids, index.data, index.ids,
+                                  index.counts, index.norms, qc, int(k),
+                                  int(n_probes), index.metric)
+    return chunked_queries(run, q, int(p.query_chunk))
 
 
 # ---------------------------------------------------------------------------

@@ -6,15 +6,25 @@ configs (``BASELINE.json``: ivf_pq on DEEP-10M) and standard IVF-PQ
 
 * **Residual PQ**: each vector stores ``pq_dim`` sub-codes indexing
   per-subspace codebooks trained on coarse residuals (x − centroid).
-* **ADC search, MXU-shaped**: the per-query lookup tables are one einsum
-  ``(q, m, ds) × (m, c, ds) → (q, m, c)`` — a batched matmul over all
-  subspaces at once — and the accumulation over sub-codes is a gather+sum on
-  the VPU.  The decomposition used is
-  ``‖q − (c + r̂)‖² = ‖q − c‖² − 2⟨q − c, r̂⟩ + ‖r̂‖²`` with the stored-code
-  norm ``‖r̂‖²`` precomputed at build, so the LUT holds inner products only.
-* Lists reuse the IVF-Flat padded-slab layout with codes instead of vectors:
-  ``[n_lists, cap, pq_dim] uint8`` — 32× smaller than flat at d=128/pq 32.
-* Optional exact re-ranking lives in :mod:`raft_tpu.neighbors.refine`.
+* **Two search tiers** (two points on the memory/bandwidth curve):
+
+  - ``mode="recon"`` (default): at build time the codes are decoded once
+    into a bf16 *reconstruction slab* ``[n_lists, cap, d]`` (x̂ = c + r̂,
+    with exact f32 ‖x̂‖² kept separately).  Search gathers each probed
+    list's slab and scores it with one batched MXU dot —
+    ``‖q−x̂‖² = ‖q‖² − 2⟨q,x̂⟩ + ‖x̂‖²`` — so the hot loop is a dense
+    bf16 contraction, the shape TPUs are built for.  The slab is
+    *derived* state: it is rebuilt from the codes on load and never
+    serialized, so the persisted index stays PQ-compressed.
+  - ``mode="lut"``: classic ADC from the uint8 codes via per-query lookup
+    tables (the einsum LUT + gather path).  4× less HBM gather traffic
+    per candidate than recon at pq_dim = d/2·…, but the table gather is
+    VPU-bound on TPU; use it when HBM capacity, not speed, binds (the
+    slab is 2·d bytes/vector vs pq_dim bytes/vector).
+
+* Lists reuse the IVF-Flat padded-slab layout (device-packed via
+  :mod:`._packing`); optional exact re-ranking lives in
+  :mod:`raft_tpu.neighbors.refine`.
 """
 
 from __future__ import annotations
@@ -27,10 +37,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..cluster.kmeans import KMeansParams, capped_assign, kmeans_balanced_fit, kmeans_fit
+from ..cluster.kmeans import KMeansParams, capped_assign, kmeans_balanced_fit
 from ..core.array import wrap_array
 from ..core.errors import expects
 from ..distance.pairwise import sq_l2
+from ._packing import chunked_queries, pack_lists
 from .brute_force import tile_knn_merge
 
 __all__ = [
@@ -53,13 +64,19 @@ class IvfPqIndexParams:
     kmeans_n_iters: int = 20
     kmeans_trainset_fraction: float = 0.1
     pq_kmeans_n_iters: int = 15
-    list_cap_ratio: float = 2.0
+    # capacity = ratio · n/n_lists; capped_assign spills overflow to the
+    # next-nearest list, so 1.25–1.5 loses nothing and pads far less than
+    # the r1 default of 2.0 (padding = wasted gather bandwidth at search)
+    list_cap_ratio: float = 1.5
+    store_recon: bool = True  # build the bf16 reconstruction slab
     seed: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
 class IvfPqSearchParams:
     n_probes: int = 32
+    mode: str = "auto"       # auto | recon | lut
+    query_chunk: int = 4096  # cap on [chunk, cap, d] gather working set
 
 
 @jax.tree_util.register_dataclass
@@ -72,6 +89,12 @@ class IvfPqIndex:
     ids: jax.Array           # [L, cap] int32, -1 pad
     counts: jax.Array        # [L]
     metric: str = dataclasses.field(metadata=dict(static=True))
+    # Derived tier (never serialized; rebuilt from codes via with_recon()):
+    recon: Optional[jax.Array] = None        # [L, cap, d] bf16 x̂ slab
+    recon_norms: Optional[jax.Array] = None  # [L, cap] f32 ‖x̂‖², +inf pads
+
+    # save_index skips these; load_index restores them via with_recon()
+    _derived_fields = ("recon", "recon_norms")
 
     @property
     def n_lists(self) -> int:
@@ -92,6 +115,22 @@ class IvfPqIndex:
     @property
     def size(self) -> int:
         return int(jnp.sum(self.counts))
+
+    def with_recon(self) -> "IvfPqIndex":
+        """Return a copy with the derived reconstruction slab materialized
+        (idempotent).  Used after :func:`load_index`, which persists only
+        the PQ-compressed state."""
+        if self.recon is not None:
+            return self
+        recon, recon_norms = _decode_slab(
+            self.codes, self.centroids, self.codebooks, self.ids)
+        return dataclasses.replace(self, recon=recon, recon_norms=recon_norms)
+
+    def without_recon(self) -> "IvfPqIndex":
+        """Drop the derived slab (memory tier / pre-serialization)."""
+        if self.recon is None:
+            return self
+        return dataclasses.replace(self, recon=None, recon_norms=None)
 
 
 def _split_subspaces(x, m: int):
@@ -153,6 +192,46 @@ def _encode(residuals, codebooks, m: int):
     return codes.T, jnp.sum(norms, axis=0)  # [n, m], [n]
 
 
+@jax.jit
+def _decode_slab(codes, centroids, codebooks, ids):
+    """Decode packed codes → bf16 reconstruction slab + exact f32 ‖x̂‖².
+
+    Chunked over lists (lax.map) so the f32 intermediate never exceeds a
+    ~256-list block; pad entries (id < 0) get ‖x̂‖² = +inf so the L2
+    search path masks them for free.
+    """
+    L, cap, m = codes.shape
+    d = centroids.shape[1]
+    block = max(1, min(L, max(1, (1 << 24) // max(cap * d, 1))))
+    pad = (-L) % block
+    codes_p = jnp.pad(codes, ((0, pad), (0, 0), (0, 0)))
+    cent_p = jnp.pad(centroids, ((0, pad), (0, 0)))
+    ids_p = jnp.pad(ids, ((0, pad), (0, 0)), constant_values=-1)
+    sub = jnp.arange(m)
+
+    def decode_block(args):
+        cb_codes, cb_cent, cb_ids = args
+        g = codebooks[sub[None, None, :], cb_codes.astype(jnp.int32)]
+        rec = (g.reshape(cb_codes.shape[0], cap, d).astype(jnp.float32)
+               + cb_cent[:, None, :].astype(jnp.float32))
+        rec_b = rec.astype(jnp.bfloat16)
+        # norms of the *rounded* slab: the search dot sees bf16 x̂, so a
+        # consistent ‖x̂‖² makes the score the exact distance to the stored
+        # point (an inconsistent f32 norm injects rank noise ~2ε‖q‖‖x̂‖)
+        rec_f = rec_b.astype(jnp.float32)
+        norms = jnp.sum(rec_f * rec_f, axis=2)
+        norms = jnp.where(cb_ids >= 0, norms, jnp.inf)
+        return rec_b, norms
+
+    rec, norms = jax.lax.map(
+        decode_block,
+        (codes_p.reshape(-1, block, cap, m),
+         cent_p.reshape(-1, block, d),
+         ids_p.reshape(-1, block, cap)),
+    )
+    return (rec.reshape(-1, cap, d)[:L], norms.reshape(-1, cap)[:L])
+
+
 def build(dataset, params: Optional[IvfPqIndexParams] = None, *,
           source_ids=None, res=None) -> IvfPqIndex:
     p = params or IvfPqIndexParams()
@@ -178,41 +257,70 @@ def build(dataset, params: Optional[IvfPqIndexParams] = None, *,
     codebooks = _train_codebooks(res_train, jax.random.fold_in(key, 7), m, c,
                                  p.pq_kmeans_n_iters)
 
-    # encode the full dataset
+    # encode the full dataset against its assigned centroid
     residuals = x - centroids[jnp.clip(labels, 0, p.n_lists - 1)]
     codes, cnorms = _encode(residuals, codebooks, m)
 
-    # pack lists (same host scatter as IVF-Flat)
-    ids = (np.asarray(source_ids, np.int32) if source_ids is not None
-           else np.arange(n, dtype=np.int32))
-    labels_np = np.asarray(labels)
-    codes_np = np.asarray(codes)
-    cn_np = np.asarray(cnorms)
+    # pack lists on device (jitted sort+scatter)
+    ids = (jnp.asarray(source_ids, jnp.int32) if source_ids is not None
+           else jnp.arange(n, dtype=jnp.int32))
+    (pk_codes, pk_norms, pk_ids), counts = pack_lists(
+        labels, (codes, cnorms, ids),
+        n_lists=p.n_lists, cap=cap, fills=(0, 0.0, -1))
 
-    keep = labels_np >= 0
-    order = np.argsort(np.where(keep, labels_np, p.n_lists), kind="stable")
-    order = order[: int(keep.sum())]
-    sl = labels_np[order]
-    counts = np.bincount(sl, minlength=p.n_lists).astype(np.int32)
-    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
-    pos = np.arange(order.shape[0]) - starts[sl]
-    packed_codes = np.zeros((p.n_lists, cap, m), np.uint8)
-    packed_norms = np.zeros((p.n_lists, cap), np.float32)
-    packed_ids = np.full((p.n_lists, cap), -1, np.int32)
-    ok = pos < cap
-    packed_codes[sl[ok], pos[ok]] = codes_np[order[ok]]
-    packed_norms[sl[ok], pos[ok]] = cn_np[order[ok]]
-    packed_ids[sl[ok], pos[ok]] = ids[order[ok]]
-    counts = np.minimum(counts, cap)
+    index = IvfPqIndex(centroids, codebooks, pk_codes, pk_norms, pk_ids,
+                       counts, p.metric)
+    return index.with_recon() if p.store_recon else index
 
-    return IvfPqIndex(centroids, codebooks, jnp.asarray(packed_codes),
-                      jnp.asarray(packed_norms), jnp.asarray(packed_ids),
-                      jnp.asarray(counts), p.metric)
+
+# ---------------------------------------------------------------------------
+# Search — recon tier (dense bf16 MXU scoring over the decoded slab).
+# ---------------------------------------------------------------------------
 
 
 @partial(jax.jit, static_argnames=("k", "n_probes", "metric"))
-def _search_impl(centroids, codebooks, codes, code_norms, ids, counts, q,
-                 k: int, n_probes: int, metric: str):
+def _search_recon_impl(centroids, recon, recon_norms, ids, q,
+                       k: int, n_probes: int, metric: str):
+    nq, d = q.shape
+    cap = recon.shape[1]
+    qf = q.astype(jnp.float32)
+    qn = jnp.sum(qf * qf, axis=1)
+    qb = q.astype(jnp.bfloat16)
+    cd = sq_l2(q, centroids)                      # [nq, L]
+    _, probes = jax.lax.top_k(-cd, n_probes)
+
+    def step(carry, p):
+        best_val, best_idx = carry
+        lists = probes[:, p]                      # [nq]
+        slab = recon[lists]                       # [nq, cap, d] bf16 gather
+        vids = ids[lists]
+        dots = jnp.einsum("qcd,qd->qc", slab, qb,
+                          preferred_element_type=jnp.float32)
+        if metric == "inner_product":
+            dist = jnp.where(vids >= 0, -dots, jnp.inf)
+        else:
+            # recon_norms carries +inf on pad entries — they self-mask
+            dist = qn[:, None] - 2.0 * dots + recon_norms[lists]
+        return tile_knn_merge(best_val, best_idx, dist, vids, k), None
+
+    init = (jnp.full((nq, k), jnp.inf, jnp.float32),
+            jnp.full((nq, k), -1, jnp.int32))
+    (bv, bi), _ = jax.lax.scan(step, init, jnp.arange(n_probes))
+    if metric == "euclidean":
+        bv = jnp.sqrt(jnp.maximum(bv, 0.0))
+    elif metric == "inner_product":
+        bv = -bv
+    return bv, bi
+
+
+# ---------------------------------------------------------------------------
+# Search — LUT/ADC tier (uint8 codes, per-query lookup tables).
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("k", "n_probes", "metric"))
+def _search_lut_impl(centroids, codebooks, codes, code_norms, ids, counts, q,
+                     k: int, n_probes: int, metric: str):
     nq, d = q.shape
     m, c, ds = codebooks.shape
     cap = codes.shape[1]
@@ -270,15 +378,28 @@ def _search_impl(centroids, codebooks, codes, code_norms, ids, counts, q,
 def search(index: IvfPqIndex, queries, k: int,
            params: Optional[IvfPqSearchParams] = None, *, res=None
            ) -> Tuple[jax.Array, jax.Array]:
-    """Approximate kNN over PQ codes; combine with
+    """Approximate kNN over the PQ index; combine with
     :func:`raft_tpu.neighbors.refine.refine` for exact re-ranking."""
     p = params or IvfPqSearchParams()
     q = wrap_array(queries, ndim=2, name="queries")
     expects(q.shape[1] == index.dim, "query dim mismatch")
+    expects(p.mode in ("auto", "recon", "lut"), f"unknown mode {p.mode!r}")
     n_probes = min(p.n_probes, index.n_lists)
-    return _search_impl(index.centroids, index.codebooks, index.codes,
-                        index.code_norms, index.ids, index.counts, q,
-                        int(k), int(n_probes), index.metric)
+    mode = p.mode
+    if mode == "auto":
+        mode = "recon" if index.recon is not None else "lut"
+    if mode == "recon":
+        expects(index.recon is not None,
+                "mode='recon' needs the reconstruction slab — call "
+                "index.with_recon() (e.g. after load_index)")
+        run = lambda qc: _search_recon_impl(
+            index.centroids, index.recon, index.recon_norms, index.ids,
+            qc, int(k), int(n_probes), index.metric)
+    else:
+        run = lambda qc: _search_lut_impl(
+            index.centroids, index.codebooks, index.codes, index.code_norms,
+            index.ids, index.counts, qc, int(k), int(n_probes), index.metric)
+    return chunked_queries(run, q, int(p.query_chunk))
 
 
 # ---------------------------------------------------------------------------
@@ -302,32 +423,34 @@ def build_sharded(dataset, mesh, params: Optional[IvfPqIndexParams] = None,
     index = build(dataset, p)
     shard = NamedSharding(mesh, P(axis))
     replicated = NamedSharding(mesh, P())
+    put = lambda a: None if a is None else jax.device_put(a, shard)
     return IvfPqIndex(
         jax.device_put(index.centroids, shard),
         jax.device_put(index.codebooks, replicated),
-        jax.device_put(index.codes, shard),
-        jax.device_put(index.code_norms, shard),
-        jax.device_put(index.ids, shard),
-        jax.device_put(index.counts, shard),
+        put(index.codes),
+        put(index.code_norms),
+        put(index.ids),
+        put(index.counts),
         index.metric,
+        put(index.recon),
+        put(index.recon_norms),
     )
 
 
-@partial(jax.jit, static_argnames=("k", "n_probes", "metric", "axis", "mesh"))
+@partial(jax.jit, static_argnames=("k", "n_probes", "metric", "axis", "mesh",
+                                   "mode"))
 def _search_sharded_impl(mesh, axis, centroids, codebooks, codes, code_norms,
-                         ids, counts, q, k: int, n_probes: int, metric: str):
+                         ids, counts, recon, recon_norms, q,
+                         k: int, n_probes: int, metric: str, mode: str):
     from jax.sharding import PartitionSpec as P
 
-    def local(centroids_l, codebooks_l, codes_l, code_norms_l, ids_l,
-              counts_l, q_l):
-        bv, bi = _search_impl(centroids_l, codebooks_l, codes_l, code_norms_l,
-                              ids_l, counts_l, q_l, k, n_probes, metric)
+    def merge(bv, bi, nq_l):
         if metric == "inner_product":
             bv = -bv  # back to min-selectable for the cross-shard merge
         av = jax.lax.all_gather(bv, axis, tiled=False)   # [S, nq, k]
         ai = jax.lax.all_gather(bi, axis, tiled=False)
-        av = jnp.moveaxis(av, 0, 1).reshape(q_l.shape[0], -1)
-        ai = jnp.moveaxis(ai, 0, 1).reshape(q_l.shape[0], -1)
+        av = jnp.moveaxis(av, 0, 1).reshape(nq_l, -1)
+        ai = jnp.moveaxis(ai, 0, 1).reshape(nq_l, -1)
         from ..matrix.select_k import select_k
 
         fv, fi = select_k(av, k, in_idx=ai, select_min=True)
@@ -335,28 +458,53 @@ def _search_sharded_impl(mesh, axis, centroids, codebooks, codes, code_norms,
             fv = -fv
         return fv, fi
 
+    if mode == "recon":
+        def local(centroids_l, recon_l, recon_norms_l, ids_l, q_l):
+            bv, bi = _search_recon_impl(centroids_l, recon_l, recon_norms_l,
+                                        ids_l, q_l, k, n_probes, metric)
+            return merge(bv, bi, q_l.shape[0])
+
+        return jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(axis), P(axis), P(axis), P(axis), P()),
+            out_specs=(P(), P()), check_vma=False,
+        )(centroids, recon, recon_norms, ids, q)
+
+    def local(centroids_l, codebooks_l, codes_l, code_norms_l, ids_l,
+              counts_l, q_l):
+        bv, bi = _search_lut_impl(centroids_l, codebooks_l, codes_l,
+                                  code_norms_l, ids_l, counts_l, q_l,
+                                  k, n_probes, metric)
+        return merge(bv, bi, q_l.shape[0])
+
     return jax.shard_map(
-        local,
-        mesh=mesh,
+        local, mesh=mesh,
         in_specs=(P(axis), P(), P(axis), P(axis), P(axis), P(axis), P()),
-        out_specs=(P(), P()),
-        check_vma=False,
+        out_specs=(P(), P()), check_vma=False,
     )(centroids, codebooks, codes, code_norms, ids, counts, q)
 
 
 def search_sharded(index: IvfPqIndex, queries, k: int,
                    params: Optional[IvfPqSearchParams] = None, *,
                    mesh, axis: str = "shard") -> Tuple[jax.Array, jax.Array]:
-    """Multi-chip ADC search: each shard probes its ``n_probes`` nearest
+    """Multi-chip search: each shard probes its ``n_probes`` nearest
     *local* lists (union over shards covers the globally nearest lists),
     one all_gather of (nq, k) candidates merges over ICI."""
     p = params or IvfPqSearchParams()
     q = wrap_array(queries, ndim=2, name="queries")
     expects(q.shape[1] == index.dim, "query dim mismatch")
+    expects(p.mode in ("auto", "recon", "lut"), f"unknown mode {p.mode!r}")
     n_dev = int(mesh.shape[axis])
     local_lists = index.n_lists // n_dev
     n_probes = min(p.n_probes, local_lists)
+    mode = p.mode
+    if mode == "auto":
+        mode = "recon" if index.recon is not None else "lut"
+    if mode == "recon":
+        expects(index.recon is not None,
+                "mode='recon' needs the reconstruction slab — call "
+                "index.with_recon() (e.g. after load_index)")
     return _search_sharded_impl(mesh, axis, index.centroids, index.codebooks,
                                 index.codes, index.code_norms, index.ids,
-                                index.counts, q, int(k), int(n_probes),
-                                index.metric)
+                                index.counts, index.recon, index.recon_norms,
+                                q, int(k), int(n_probes), index.metric, mode)

@@ -41,8 +41,14 @@ def save_index(path: Union[str, os.PathLike], index) -> None:
     cls = type(index)
     if cls.__name__ not in _index_registry():
         raise TypeError(f"not a serializable index type: {cls.__name__}")
+    # derived fields (e.g. IVF-PQ's bf16 reconstruction slab) are rebuilt
+    # from the persisted state on load — writing them would double the
+    # artifact and defeat PQ compression on disk
+    derived = tuple(getattr(cls, "_derived_fields", ()))
     arrays, static = {}, {}
     for f in dataclasses.fields(index):
+        if f.name in derived:
+            continue
         v = getattr(index, f.name)
         if isinstance(v, (jax.Array, np.ndarray)):
             arrays[f.name] = np.asarray(v)
@@ -52,6 +58,8 @@ def save_index(path: Union[str, os.PathLike], index) -> None:
         "index_type": cls.__name__,
         "format_version": _FORMAT_VERSION,
         "static": static,
+        "derived_present": [f for f in derived
+                            if getattr(index, f, None) is not None],
     })
 
 
@@ -70,4 +78,7 @@ def load_index(path: Union[str, os.PathLike], *, device: bool = True):
     fields = dict(meta.get("static", {}))
     for name, arr in arrays.items():
         fields[name] = jax.device_put(arr) if device else arr
-    return registry[type_name](**fields)
+    index = registry[type_name](**fields)
+    if meta.get("derived_present") and device and hasattr(index, "with_recon"):
+        index = index.with_recon()  # rebuild the derived search tier
+    return index

@@ -1,6 +1,8 @@
 """IO layer tests: native C++ reader vs NumPy references (SURVEY.md §4's
 kernel-vs-naive-host-reference pattern applied to the IO subsystem)."""
 
+import shutil
+
 import numpy as np
 import pytest
 
@@ -16,9 +18,25 @@ def _write_vecs(path, mat, elem_dtype):
             mat[r].astype(elem_dtype).tofile(f)
 
 
+@pytest.mark.skipif(
+    not (shutil.which("g++") and shutil.which("make")),
+    reason="no C++ toolchain — package contract degrades to pure NumPy",
+)
 def test_native_builds():
-    # the toolchain is present in this environment, so the fast path must load
+    # with a toolchain present the fast path must load
     assert native.available()
+
+
+def test_npy_ndim_overflow_falls_back(tmp_path):
+    """ndim > 8 exceeds the native header struct: the native parser must
+    error (not silently truncate) so the np.load fallback returns the full
+    array (ADVICE r1, cpp/raft_tpu_io.cpp rt_npy_header)."""
+    a = np.arange(2 ** 9, dtype=np.float32).reshape((2,) * 9)
+    p = str(tmp_path / "deep.npy")
+    np.save(p, a)
+    out = rio.read_npy(p)
+    assert out.shape == a.shape
+    np.testing.assert_array_equal(out, a)
 
 
 @pytest.mark.parametrize("ext,dtype", [(".fvecs", np.float32),
