@@ -36,6 +36,7 @@ from ..utils.segment import within_group_rank as _within_group_rank
 __all__ = [
     "KMeansParams",
     "capped_assign",
+    "capped_assign_room",
     "kmeans_plus_plus_init",
     "kmeans_fit",
     "kmeans_predict",
@@ -257,6 +258,44 @@ def _assign_balanced(x, c, counts, penalty, n_per):
     return labels, real
 
 
+def _capped_assign_impl(x, centroids, room):
+    """Shared core of :func:`capped_assign` / :func:`capped_assign_room`:
+    ``room`` is a traced per-cluster capacity vector (k,) int32."""
+    n = x.shape[0]
+    k = centroids.shape[0]
+    d2 = sq_l2(x, centroids)
+    INF = jnp.float32(jnp.inf)
+
+    def cond(carry):
+        labels, counts, prev_left = carry
+        left = jnp.sum((labels < 0).astype(jnp.int32))
+        return (left > 0) & (left != prev_left)
+
+    def round_fn(carry):
+        labels, counts, _ = carry
+        prev_left = jnp.sum((labels < 0).astype(jnp.int32))
+        unassigned = labels < 0
+        full = counts >= room
+        cost = jnp.where(full[None, :], INF, d2)
+        cand = jnp.argmin(cost, axis=1).astype(jnp.int32)
+        req_d2 = jnp.where(unassigned, jnp.take_along_axis(d2, cand[:, None], 1)[:, 0], INF)
+        rank = _within_group_rank(cand, req_d2, k)
+        left_room = (room - counts)[cand]
+        accept = unassigned & (rank < left_room)
+        labels = jnp.where(accept, cand, labels)
+        counts = counts + jax.ops.segment_sum(
+            accept.astype(jnp.int32), cand, num_segments=k
+        )
+        return labels, counts, prev_left
+
+    labels0 = jnp.full((n,), -1, jnp.int32)
+    counts0 = jnp.zeros((k,), jnp.int32)
+    labels, counts, _ = jax.lax.while_loop(
+        cond, round_fn, (labels0, counts0, jnp.int32(-1))
+    )
+    return labels, counts
+
+
 @partial(jax.jit, static_argnames=("cap",))
 def capped_assign(x, centroids, cap: int):
     """Capacity-constrained nearest-centroid assignment.
@@ -273,39 +312,17 @@ def capped_assign(x, centroids, cap: int):
     then keep label -1.  While capacity remains, each round accepts at least
     one point, so termination ≡ completion.
     """
-    n = x.shape[0]
     k = centroids.shape[0]
-    d2 = sq_l2(x, centroids)
-    INF = jnp.float32(jnp.inf)
+    return _capped_assign_impl(x, centroids, jnp.full((k,), cap, jnp.int32))
 
-    def cond(carry):
-        labels, counts, prev_left = carry
-        left = jnp.sum((labels < 0).astype(jnp.int32))
-        return (left > 0) & (left != prev_left)
 
-    def round_fn(carry):
-        labels, counts, _ = carry
-        prev_left = jnp.sum((labels < 0).astype(jnp.int32))
-        unassigned = labels < 0
-        full = counts >= cap
-        cost = jnp.where(full[None, :], INF, d2)
-        cand = jnp.argmin(cost, axis=1).astype(jnp.int32)
-        req_d2 = jnp.where(unassigned, jnp.take_along_axis(d2, cand[:, None], 1)[:, 0], INF)
-        rank = _within_group_rank(cand, req_d2, k)
-        room = (cap - counts)[cand]
-        accept = unassigned & (rank < room)
-        labels = jnp.where(accept, cand, labels)
-        counts = counts + jax.ops.segment_sum(
-            accept.astype(jnp.int32), cand, num_segments=k
-        )
-        return labels, counts, prev_left
-
-    labels0 = jnp.full((n,), -1, jnp.int32)
-    counts0 = jnp.zeros((k,), jnp.int32)
-    labels, counts, _ = jax.lax.while_loop(
-        cond, round_fn, (labels0, counts0, jnp.int32(-1))
-    )
-    return labels, counts
+@jax.jit
+def capped_assign_room(x, centroids, room):
+    """:func:`capped_assign` against a traced per-cluster ``room`` vector
+    (k,) — the streaming-build variant: chunked index builds pass the
+    *remaining* capacity of each list (``cap - counts_so_far``) so a chunk
+    can never overflow lists filled by earlier chunks."""
+    return _capped_assign_impl(x, centroids, jnp.asarray(room, jnp.int32))
 
 
 @partial(jax.jit, static_argnames=("k", "max_iter", "cap"))

@@ -280,6 +280,11 @@ class Comms:
         self.mesh = mesh
         self.axis = axis if axis is not None else mesh.axis_names[0]
         expects(self.axis in mesh.axis_names, f"axis {self.axis!r} not in mesh")
+        # jitted shard_map programs keyed by (verb, static-params,
+        # out_replicated, n_args); jax.jit's own cache then handles
+        # shape/dtype specialization — so repeated eager verbs re-trace
+        # only on new (verb, shape) combinations, not every call.
+        self._programs: dict = {}
 
     # -- introspection ------------------------------------------------------
     def get_size(self) -> int:
@@ -302,71 +307,96 @@ class Comms:
         jax.effects_barrier()
 
     # -- eager collectives --------------------------------------------------
-    def _run(self, fn: Callable, *arrays, out_replicated: bool = False):
-        """shard_map `fn` over per-rank-stacked inputs [size, ...]."""
+    def _run(self, key, fn: Callable, *arrays, out_replicated: bool = False):
+        """shard_map `fn` over per-rank-stacked inputs [size, ...].
+
+        ``key`` identifies the verb + its static parameters; the jitted
+        shard_map program is built once per key and cached, so calling the
+        same verb repeatedly hits jax.jit's dispatch cache instead of
+        rebuilding (and re-tracing) a fresh program every call.
+        """
         size = self.get_size()
-        specs = []
         for a in arrays:
             expects(a.shape[0] == size, f"leading dim must equal comm size {size}")
-            specs.append(P(self.axis))
-        out_spec = P() if out_replicated else P(self.axis)
-        mapped = shard_map(
-            fn,
-            mesh=self.mesh,
-            in_specs=tuple(specs),
-            out_specs=out_spec,
-            check_vma=False,
-        )
-        squeezed = [a for a in arrays]
-        return jax.jit(mapped)(*squeezed)
+        cache_key = (key, out_replicated, len(arrays))
+        prog = self._programs.get(cache_key)
+        if prog is None:
+            specs = tuple(P(self.axis) for _ in arrays)
+            out_spec = P() if out_replicated else P(self.axis)
+            prog = jax.jit(shard_map(
+                fn,
+                mesh=self.mesh,
+                in_specs=specs,
+                out_specs=out_spec,
+                check_vma=False,
+            ))
+            self._programs[cache_key] = prog
+        return prog(*arrays)
 
     def allreduce(self, x, op: Op = Op.SUM):
         """Per-rank rows ``x[size, ...]`` → reduced row replicated to all."""
         return self._run(
+            ("allreduce", op),
             lambda v: allreduce(v[0], op, axis=self.axis)[None],
             x,
         )
 
     def reduce(self, x, op: Op = Op.SUM, root: int = 0):
-        return self._run(lambda v: reduce(v[0], op, root, axis=self.axis)[None], x)
+        return self._run(("reduce", op, root),
+                         lambda v: reduce(v[0], op, root, axis=self.axis)[None], x)
 
     def bcast(self, x, root: int = 0):
-        return self._run(lambda v: bcast(v[0], root, axis=self.axis)[None], x)
+        return self._run(("bcast", root),
+                         lambda v: bcast(v[0], root, axis=self.axis)[None], x)
 
     def allgather(self, x):
         """x[size, n, ...] → [size, size*n, ...]: flat concat on all ranks
         (NCCL allgather concatenation semantics)."""
-        return self._run(lambda v: allgather(v[0], axis=self.axis, tiled=True)[None], x)
+        return self._run(("allgather",),
+                         lambda v: allgather(v[0], axis=self.axis, tiled=True)[None], x)
 
     def allgatherv(self, x, counts: Sequence[int]):
-        return self._run(lambda v: allgatherv(v[0], counts, axis=self.axis)[None], x)
+        counts = tuple(int(c) for c in counts)
+        return self._run(("allgatherv", counts),
+                         lambda v: allgatherv(v[0], counts, axis=self.axis)[None], x)
 
     def gather(self, x, root: int = 0):
-        return self._run(lambda v: gather(v[0], root, axis=self.axis)[None], x)
+        return self._run(("gather", root),
+                         lambda v: gather(v[0], root, axis=self.axis)[None], x)
 
     def gatherv(self, x, counts: Sequence[int], root: int = 0):
-        return self._run(lambda v: gatherv(v[0], counts, root, axis=self.axis)[None], x)
+        counts = tuple(int(c) for c in counts)
+        return self._run(("gatherv", counts, root),
+                         lambda v: gatherv(v[0], counts, root, axis=self.axis)[None], x)
 
     def reducescatter(self, x, op: Op = Op.SUM):
-        return self._run(lambda v: reducescatter(v[0], op, axis=self.axis)[None], x)
+        return self._run(("reducescatter", op),
+                         lambda v: reducescatter(v[0], op, axis=self.axis)[None], x)
 
     def alltoall(self, x):
-        return self._run(lambda v: alltoall(v[0], axis=self.axis)[None], x)
+        return self._run(("alltoall",),
+                         lambda v: alltoall(v[0], axis=self.axis)[None], x)
 
     def sendrecv(self, x, perm: Sequence[Tuple[int, int]]):
-        return self._run(lambda v: sendrecv(v[0], perm, axis=self.axis)[None], x)
+        perm = tuple((int(a), int(b)) for a, b in perm)
+        return self._run(("sendrecv", perm),
+                         lambda v: sendrecv(v[0], perm, axis=self.axis)[None], x)
 
     def ring_shift(self, x, offset: int = 1):
-        return self._run(lambda v: ring_shift(v[0], offset, axis=self.axis)[None], x)
+        return self._run(("ring_shift", offset),
+                         lambda v: ring_shift(v[0], offset, axis=self.axis)[None], x)
 
     def multicast_sendrecv(self, x, sends: Sequence[Sequence[int]]):
+        sends = tuple(tuple(int(d) for d in row) for row in sends)
         return self._run(
+            ("multicast_sendrecv", sends),
             lambda v: multicast_sendrecv(v[0], sends, axis=self.axis)[None], x
         )
 
     def barrier(self):
         size = self.get_size()
         self._run(
+            ("barrier",),
             lambda v: (barrier(axis=self.axis) * 0 + v[0])[None],
             jnp.zeros((size,), jnp.int32),
         )
@@ -442,7 +472,9 @@ class SplitComms:
         return jnp.prod(jnp.where(shaped, gathered, 1), axis=0)
 
     def allreduce(self, x, op: Op = Op.SUM):
-        return self.parent._run(lambda v: self.t_allreduce(v[0], op)[None], x)
+        return self.parent._run(
+            ("split_allreduce", tuple(self.color), tuple(self.key), op),
+            lambda v: self.t_allreduce(v[0], op)[None], x)
 
 
 def build_comms(mesh: Mesh, axis: Optional[str] = None) -> Comms:

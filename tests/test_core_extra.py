@@ -104,3 +104,49 @@ class TestMDBuffer:
     def test_unknown_memory_type(self):
         with pytest.raises(ValueError):
             MDBuffer(np.zeros(1)).view("managed")
+
+
+class TestLayoutCopy:
+    """``raft::copy`` parity (``core/copy.hpp``): layout/memory/dtype moves."""
+
+    def test_f_order_host_to_device_preserves_values(self):
+        from raft_tpu.core import copy
+        f = np.asfortranarray(np.arange(12, dtype=np.float32).reshape(3, 4))
+        d = copy(f, memory="device")
+        assert isinstance(d, jax.Array)
+        np.testing.assert_array_equal(np.asarray(d), f)
+
+    def test_device_to_host_f_layout(self):
+        from raft_tpu.core import copy
+        d = jnp.arange(12, dtype=jnp.float32).reshape(3, 4)
+        h = copy(d, memory="host", layout="F")
+        assert h.flags.f_contiguous and not h.flags.c_contiguous
+        np.testing.assert_array_equal(h, np.asarray(d))
+
+    def test_host_layout_transposing_copy(self):
+        from raft_tpu.core import copy
+        c = np.arange(6, dtype=np.float64).reshape(2, 3)
+        f = copy(c, layout="F")
+        assert f.flags.f_contiguous
+        back = copy(f, layout="C")
+        assert back.flags.c_contiguous
+        np.testing.assert_array_equal(back, c)
+
+    def test_dtype_conversion_and_noop_fast_path(self):
+        from raft_tpu.core import copy
+        d = jnp.arange(4, dtype=jnp.float32)
+        assert copy(d) is d  # nothing requested → no copy
+        h = copy(d, memory="host", dtype=np.float64)
+        assert h.dtype == np.float64
+
+    def test_device_f_layout_rejected(self):
+        from raft_tpu.core import copy
+        with pytest.raises(Exception):
+            copy(np.zeros((2, 2)), memory="device", layout="F")
+
+    def test_strided_host_source_normalized(self):
+        from raft_tpu.core import copy
+        base = np.arange(24, dtype=np.float32).reshape(4, 6)
+        view = base[::2, ::3]  # non-contiguous strides
+        d = copy(view, memory="device")
+        np.testing.assert_array_equal(np.asarray(d), view)
