@@ -1,0 +1,95 @@
+"""CAGRA graph-quality study at ≥1M rows (VERDICT r2 next #6).
+
+Measures recall@fixed-effort of three search substrates over the same
+dataset and query set:
+
+* the **optimized** graph (rank-merge forward/reverse union — the CAGRA
+  detour-pruning stand-in, ``neighbors.cagra.optimize_graph``),
+* the **raw kNN** graph it was built from (same degree),
+* **brute force** (recall 1.0 by construction — the QPS denominator).
+
+Run on the target backend:  ``python bench/cagra_quality.py [--rows N]``
+Writes ``bench/CAGRA_QUALITY.json`` (committed each round) with the table;
+the companion gate lives in ``tests/test_cagra.py``
+(``test_graph_quality_1m_rows``, slow-marked).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ann import ground_truth, make_clustered, measure_qps
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "CAGRA_QUALITY.json")
+
+
+def main() -> None:
+    rows = 1_000_000
+    if "--rows" in sys.argv:
+        rows = int(sys.argv[sys.argv.index("--rows") + 1])
+    d, nq, k = 96, 2000, 10
+    n_clusters = max(64, rows // 1000)
+
+    from raft_tpu.neighbors import cagra
+
+    t0 = time.time()
+    data = make_clustered(rows + nq, d, n_clusters, seed=3, scale=2.0)
+    db, q = data[:rows], data[rows:]
+    gt = ground_truth(q, db, k)
+    print(f"data+gt: {time.time() - t0:.1f}s", file=sys.stderr)
+
+    t0 = time.time()
+    p = cagra.CagraIndexParams(
+        intermediate_graph_degree=64, graph_degree=32,
+        build_algo="ivf" if rows > 200_000 else "brute_force",
+        n_routers=max(128, min(1024, n_clusters // 2)))
+    idx = cagra.build(db, p)
+    build_s = time.time() - t0
+    print(f"build: {build_s:.1f}s", file=sys.stderr)
+
+    # raw-graph baseline: same beam search over the UNoptimized kNN graph,
+    # truncated to the same degree (isolates the optimize step's value)
+    from raft_tpu.neighbors import ivf_flat
+    ip = ivf_flat.IvfFlatIndexParams(
+        n_lists=max(16, int(np.sqrt(rows))), seed=p.seed)
+    fidx = ivf_flat.build(db, ip)
+    _, raw_nbrs = ivf_flat.search(
+        fidx, db, p.graph_degree + 1,
+        ivf_flat.IvfFlatSearchParams(n_probes=16))
+    raw_graph = cagra._drop_self(jnp.asarray(raw_nbrs), p.graph_degree)
+    raw_idx = cagra.CagraIndex(idx.dataset, raw_graph, idx.router_centroids,
+                               idx.router_nodes, idx.metric)
+
+    results = {"rows": rows, "dim": d, "k": k, "build_s": round(build_s, 1),
+               "backend": jax.default_backend(), "points": []}
+    for itopk, width in [(32, 4), (64, 4), (64, 8), (128, 8)]:
+        sp = cagra.CagraSearchParams(itopk_size=itopk, search_width=width)
+        row = {"itopk": itopk, "width": width}
+        for name, ix in (("optimized", idx), ("raw_knn", raw_idx)):
+            run = lambda: cagra.search(ix, q, k, sp)
+            from ann import _fetch
+            ids = _fetch(run())[1]
+            from raft_tpu.stats import neighborhood_recall
+            rec = float(neighborhood_recall(np.asarray(ids), gt))
+            qps = measure_qps(run, nq)
+            row[name] = {"recall": round(rec, 4), "qps": round(qps, 1)}
+        results["points"].append(row)
+        print(json.dumps(row))
+
+    with open(OUT, "w") as f:
+        json.dump(results, f, indent=1)
+    print(f"wrote {OUT}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

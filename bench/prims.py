@@ -3,11 +3,17 @@
 rng, make_blobs, sparse conversions, sddmm, masked_matmul, popc, bitset;
 fixture ``common/benchmark.hpp:99,344``).
 
-Usage:  python bench/prims.py [suite ...] [--quick]
+Usage:  python bench/prims.py [suite ...] [--quick] [--no-record]
 
 Prints one JSON line per case: {"suite", "case", "ms", "items_per_s"}.
 Times are min-of-3 with host-fetch barriers (the only reliable sync on the
 remote-TPU tunnel — see bench.py).
+
+**Ratchet**: results are recorded in ``bench/PRIMS_HISTORY.json`` (committed
+each round; per-case best ms per backend).  A case ≥ 1.3× slower than its
+recorded best prints a loud ``REGRESSION`` line to stderr and the process
+exits nonzero — the per-primitive analog of bench.py's headline ratchet
+(the reference treats micro-bench as first-class; VERDICT r2 next #5).
 """
 
 from __future__ import annotations
@@ -26,10 +32,55 @@ import numpy as np
 from _timing import timeit as _time
 
 
+HISTORY = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "PRIMS_HISTORY.json")
+REGRESSION_RATIO = 1.3
+_results: list = []
+
+
 def report(suite, case, seconds, items):
     print(json.dumps({"suite": suite, "case": case,
                       "ms": round(seconds * 1e3, 3),
                       "items_per_s": round(items / seconds, 1)}))
+    _results.append((f"{suite}/{case}", seconds * 1e3))
+
+
+def ratchet(record: bool, ran_suites) -> int:
+    """Compare this run against the per-backend best and update the file.
+    Returns the number of regressions: cases ≥ REGRESSION_RATIO × best,
+    **plus recorded cases of a suite that ran but produced no result this
+    time** — a primitive that regresses into crashing must not pass the
+    gate silently."""
+    try:
+        with open(HISTORY) as f:
+            hist = json.load(f)
+    except (OSError, ValueError):
+        hist = {}
+    backend = jax.default_backend()
+    best = hist.setdefault(backend, {})
+    regressions = 0
+    seen = set()
+    for key, ms in _results:
+        seen.add(key)
+        prev = best.get(key)
+        if prev is not None and ms > prev * REGRESSION_RATIO:
+            regressions += 1
+            print(f"REGRESSION {key}: {ms:.3f} ms vs best {prev:.3f} ms "
+                  f"({ms / prev:.2f}x)", file=sys.stderr)
+        if prev is None or ms < prev:
+            best[key] = round(ms, 3)
+    for key in best:
+        suite = key.split("/", 1)[0]
+        if suite in ran_suites and key not in seen:
+            regressions += 1
+            print(f"REGRESSION {key}: recorded case produced no result "
+                  f"(crashed or dropped)", file=sys.stderr)
+    if record:
+        with open(HISTORY, "w") as f:
+            json.dump(hist, f, indent=1, sort_keys=True)
+        print(f"ratchet: {len(_results)} cases vs {HISTORY} "
+              f"[{backend}], {regressions} regression(s)", file=sys.stderr)
+    return regressions
 
 
 def bench_select_k(quick):
@@ -169,20 +220,26 @@ SUITES = {
 }
 
 
-def main() -> None:
+def main() -> int:
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
     quick = "--quick" in sys.argv
     names = args or list(SUITES)
+    ran = set()
     for name in names:
         fn = SUITES.get(name)
         if fn is None:
             print(f"unknown suite {name!r}; have {sorted(SUITES)}", file=sys.stderr)
             continue
+        ran.add(name)
         try:
             fn(quick)
         except Exception as e:  # noqa: BLE001 — keep the harness going
             print(json.dumps({"suite": name, "error": f"{type(e).__name__}: {e}"}))
+    # record only full default runs — partial/--quick runs use lighter
+    # workloads and would poison the committed bests (still compared)
+    record = not args and not quick and "--no-record" not in sys.argv
+    return ratchet(record, ran)
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(1 if main() else 0)

@@ -1,6 +1,8 @@
 """CAGRA graph index tests: graph structure invariants + search recall vs
 brute force."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -91,3 +93,26 @@ def test_cagra_sharded(blob_data, mesh8):
         cagra.CagraSearchParams(itopk_size=32, search_width=4, n_seeds=16),
         mesh=mesh8)
     assert _recall(got, want) > 0.9
+
+
+@pytest.mark.skipif(os.environ.get("RAFT_RUN_SLOW") != "1",
+                    reason="1M-row build; set RAFT_RUN_SLOW=1 (run on TPU)")
+def test_graph_quality_1m_rows():
+    """Recall >= 0.95 at itopk <= 128 on >= 1M rows (VERDICT r2 next #6).
+    The committed quality table lives in bench/CAGRA_QUALITY.json
+    (bench/cagra_quality.py regenerates it on the target backend)."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "bench"))
+    from ann import ground_truth, make_clustered
+
+    n, d = 1_000_000, 96
+    data = make_clustered(n + 2000, d, n // 1000, seed=3, scale=2.0)
+    db, q = data[:n], data[n:]
+    gt = ground_truth(q, db, 10)
+    idx = cagra.build(db, cagra.CagraIndexParams(
+        intermediate_graph_degree=64, graph_degree=32, build_algo="ivf",
+        n_routers=512))
+    _, found = cagra.search(idx, q, 10, cagra.CagraSearchParams(itopk_size=128))
+    from raft_tpu.stats import neighborhood_recall
+    rec = float(neighborhood_recall(np.asarray(found), np.asarray(gt)))
+    assert rec >= 0.95, f"1M-row graph recall {rec}"

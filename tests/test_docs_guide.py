@@ -1,0 +1,23 @@
+"""Executes every ```python block in docs/tuning_guide.md in one shared
+namespace — the guide's snippets are tested code, extending the doctest
+discipline (SURVEY.md §4) to the prose docs."""
+
+import os
+import re
+
+GUIDE = os.path.join(os.path.dirname(__file__), "..", "docs", "tuning_guide.md")
+
+
+def test_tuning_guide_snippets_execute():
+    with open(GUIDE) as f:
+        text = f.read()
+    blocks = re.findall(r"```python\n(.*?)```", text, re.DOTALL)
+    assert len(blocks) >= 5, "guide lost its examples"
+    ns: dict = {}
+    for i, block in enumerate(blocks):
+        try:
+            exec(compile(block, f"tuning_guide.md[block {i}]", "exec"), ns)
+        except AssertionError as e:
+            raise AssertionError(
+                f"tuning_guide.md block {i} failed its own assert: {e}"
+            ) from e
