@@ -114,7 +114,7 @@ def _bench_ivf_pq():
 
     n, d, nq = PQ_ROWS, 96, 10_000
     n_clusters = max(64, n // 1000)
-    n_lists = 1 << max(6, (int(np.sqrt(n)) * 2).bit_length() - 1)
+    n_lists = max(64, int(2 * np.sqrt(n)))
     db_dev = make_clustered(n, d, n_clusters, seed=11, scale=2.0)
     q = make_clustered(nq, d, n_clusters, seed=11, scale=2.0, point_seed=1)
     gt = ground_truth(q, db_dev, K)

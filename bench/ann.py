@@ -32,7 +32,9 @@ __all__ = [
     "ground_truth",
     "fetch",
     "measure_qps",
+    "measure_point",
     "single_latency",
+    "sweep_ivf_flat",
     "sweep_ivf_pq",
     "sweep_cagra",
     "best_at_recall",
@@ -85,11 +87,13 @@ def fetch(o):
 _fetch = fetch  # back-compat alias
 
 
-def ground_truth(queries, database, k: int, tile: int = 65536):
-    """Exact top-k ids (untimed) for the recall gate."""
+def ground_truth(queries, database, k: int, tile: int = 65536,
+                 metric: str = "sqeuclidean"):
+    """Exact top-k ids (untimed) for the recall gate — same metric as the
+    index under test, or every recall number is meaningless."""
     from raft_tpu.neighbors.brute_force import _knn_impl
 
-    _, gt = _knn_impl(queries, database, k, "sqeuclidean",
+    _, gt = _knn_impl(queries, database, k, metric,
                       min(tile, database.shape[0]))
     return np.asarray(gt)
 
@@ -126,6 +130,28 @@ def _recall(ids, gt) -> float:
     return float(neighborhood_recall(np.asarray(ids), gt))
 
 
+def measure_point(run, gt, nq: int) -> dict:
+    """One sweep point: run once for recall, then pipelined QPS — the
+    single implementation behind every sweep (and the CLI's one-off
+    modes), so all numbers share the timing protocol."""
+    ids = fetch(run())[1]
+    return {"recall": round(_recall(ids, gt), 4),
+            "qps": round(measure_qps(run, nq), 1)}
+
+
+def sweep_ivf_flat(index, queries, gt, k: int, probe_grid) -> List[dict]:
+    """(n_probes → recall, qps) curve for IVF-Flat."""
+    from raft_tpu.neighbors import ivf_flat
+
+    out = []
+    nq = queries.shape[0]
+    for n_probes in probe_grid:
+        p = ivf_flat.IvfFlatSearchParams(n_probes=int(n_probes))
+        run = lambda p=p: ivf_flat.search(index, queries, k, p)
+        out.append({"n_probes": int(n_probes), **measure_point(run, gt, nq)})
+    return out
+
+
 def sweep_ivf_pq(index, queries, gt, k: int, probe_grid, *,
                  refine_dataset=None, refine_ratio: int = 4
                  ) -> List[dict]:
@@ -141,17 +167,14 @@ def sweep_ivf_pq(index, queries, gt, k: int, probe_grid, *,
         p = ivf_pq.IvfPqSearchParams(n_probes=int(n_probes), query_chunk=0)
 
         if refine_dataset is None:
-            run = lambda: ivf_pq.search(index, queries, k, p)
+            run = lambda p=p: ivf_pq.search(index, queries, k, p)
         else:
-            def run():
+            def run(p=p):
                 _, cand = ivf_pq.search(index, queries, refine_ratio * k, p)
-                return refine(refine_dataset, queries, cand, k)
+                return refine(refine_dataset, queries, cand, k,
+                              metric=index.metric)
 
-        ids = _fetch(run())[1]
-        rec = _recall(ids, gt)
-        qps = measure_qps(run, nq)
-        out.append({"n_probes": int(n_probes), "recall": round(rec, 4),
-                    "qps": round(qps, 1)})
+        out.append({"n_probes": int(n_probes), **measure_point(run, gt, nq)})
     return out
 
 
@@ -165,12 +188,9 @@ def sweep_cagra(index, queries, gt, k: int, grid, seed: int = 0
     for itopk, width in grid:
         p = cagra.CagraSearchParams(itopk_size=int(itopk),
                                     search_width=int(width))
-        run = lambda: cagra.search(index, queries, k, p, seed=seed)
-        ids = _fetch(run())[1]
-        rec = _recall(ids, gt)
-        qps = measure_qps(run, nq)
+        run = lambda p=p: cagra.search(index, queries, k, p, seed=seed)
         out.append({"itopk": int(itopk), "width": int(width),
-                    "recall": round(rec, 4), "qps": round(qps, 1)})
+                    **measure_point(run, gt, nq)})
     return out
 
 

@@ -1,0 +1,178 @@
+"""ANN benchmark CLI — the raft-ann-bench role (removed upstream with the
+cuVS migration) rebuilt TPU-side: build an index from a dataset file, sweep
+search parameters, and report {recall, qps} points as JSON lines.
+
+Datasets load through :mod:`raft_tpu.io` (``.npy`` / ``.fvecs`` / ``.bvecs``
+— SIFT/DEEP/GIST TexMex formats) or are synthesized (``synthetic:N×D``)
+when no files are available.  Ground truth is computed exactly (or loaded
+from an ``.ivecs``/``.npy`` file).
+
+Examples::
+
+    # SIFT-1M layout (base/query/groundtruth files)
+    python bench/ann_bench.py ivf_pq --base sift_base.fvecs \
+        --query sift_query.fvecs --gt sift_groundtruth.ivecs \
+        --n-lists 4096 --pq-dim 64 --sweep 8,16,32,64 --refine 4
+
+    # no dataset files: synthesize a DEEP-10M-class corpus
+    python bench/ann_bench.py cagra --base synthetic:1000000x96 --k 10 \
+        --sweep 32:4,64:4,64:8
+
+Index kinds: ``brute_force`` | ``ivf_flat`` | ``ivf_pq`` | ``cagra``.
+Every result line carries the config; the last line is a summary with the
+best QPS at ``--recall-floor`` (default 0.95).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+if os.environ.get("RAFT_BENCH_PLATFORM"):  # e.g. =cpu for smoke tests
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["RAFT_BENCH_PLATFORM"])
+
+import numpy as np
+
+from ann import (best_at_recall, ground_truth, make_clustered, measure_point,
+                 sweep_cagra, sweep_ivf_flat, sweep_ivf_pq)
+
+
+def parse_synthetic(spec: str):
+    """``synthetic:NxD[:seed]`` → (n, d, seed)."""
+    parts = spec.split(":")
+    n, d = (int(v) for v in parts[1].lower().replace("×", "x").split("x"))
+    return n, d, int(parts[2]) if len(parts) > 2 else 0
+
+
+def load_matrix(spec: str, what: str, n_clusters: int = 0):
+    """Dataset file (.npy/.fvecs/.bvecs) or ``synthetic:NxD[:seed]``.
+    ``n_clusters`` (from the base spec) keeps held-out queries on the
+    SAME cluster centers — make_clustered only shares centers across
+    calls with equal ``n_clusters``."""
+    if spec.startswith("synthetic:"):
+        n, d, seed = parse_synthetic(spec)
+        return make_clustered(n, d, n_clusters or max(64, n // 1000),
+                              seed=seed, scale=2.0,
+                              point_seed=1 if what == "query" else 0)
+    from raft_tpu import io as rio
+
+    ext = os.path.splitext(spec)[1]
+    if ext == ".npy":
+        return rio.read_npy(spec)
+    if ext == ".fvecs":
+        return rio.read_fvecs(spec)
+    if ext == ".bvecs":
+        return rio.read_bvecs(spec).astype(np.float32)
+    raise SystemExit(f"{what}: unsupported dataset format {ext!r}")
+
+
+def load_gt(spec, queries, base, k, metric):
+    if spec is None:
+        return ground_truth(queries, base, k, metric=metric)
+    ext = os.path.splitext(spec)[1]
+    if ext == ".ivecs":
+        from raft_tpu import io as rio
+
+        return np.asarray(rio.read_ivecs(spec))[:, :k]
+    if ext == ".npy":
+        return np.load(spec)[:, :k]
+    raise SystemExit(f"gt: unsupported format {ext!r}")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("index", choices=["brute_force", "ivf_flat", "ivf_pq", "cagra"])
+    ap.add_argument("--base", required=True, help="dataset file or synthetic:NxD")
+    ap.add_argument("--query", default=None, help="query file (default: synthetic held-out / first 10k rows)")
+    ap.add_argument("--gt", default=None, help="ground-truth ids file (default: computed exactly)")
+    ap.add_argument("--k", type=int, default=10)
+    ap.add_argument("--metric", default="sqeuclidean")
+    ap.add_argument("--n-lists", type=int, default=0, help="0 → 2·sqrt(n) rounded")
+    ap.add_argument("--pq-dim", type=int, default=0, help="0 → d/2")
+    ap.add_argument("--refine", type=int, default=4, help="ivf_pq refine ratio (0 = off)")
+    ap.add_argument("--graph-degree", type=int, default=32)
+    ap.add_argument("--sweep", default=None,
+                    help="ivf: probe list '8,16,32'; cagra: 'itopk:width,...'")
+    ap.add_argument("--recall-floor", type=float, default=0.95)
+    ap.add_argument("--chunked", action="store_true",
+                    help="stream the build from host (out-of-core)")
+    args = ap.parse_args()
+
+    base = load_matrix(args.base, "base")
+    if args.query:
+        q = load_matrix(args.query, "query")
+    elif args.base.startswith("synthetic:"):
+        nb, d0, seed = parse_synthetic(args.base)
+        nq = min(10_000, nb // 10)
+        # same n_clusters as the base → same centers, held-out points
+        q = load_matrix(f"synthetic:{nq}x{d0}:{seed}", "query",
+                        n_clusters=max(64, nb // 1000))
+    else:
+        q = np.asarray(base[:10_000])
+    n, d = base.shape
+    gt = load_gt(args.gt, q, base, args.k, args.metric)
+    print(json.dumps({"dataset": {"rows": int(n), "dim": int(d),
+                                  "queries": int(q.shape[0]), "k": args.k}}),
+          flush=True)
+
+    n_lists = args.n_lists or max(64, int(2 * np.sqrt(n)))
+    t0 = time.time()
+    if args.index == "brute_force":
+        from raft_tpu.neighbors import brute_force
+
+        run = lambda: brute_force.knn(q, base, args.k, metric=args.metric,
+                                      mode="fast")
+        curve = [{"mode": "fast", **measure_point(run, gt, q.shape[0])}]
+    elif args.index in ("ivf_flat", "ivf_pq"):
+        mod = __import__(f"raft_tpu.neighbors.{args.index}",
+                         fromlist=[args.index])
+        if args.index == "ivf_pq":
+            p = mod.IvfPqIndexParams(n_lists=n_lists,
+                                     pq_dim=args.pq_dim or d // 2,
+                                     metric=args.metric)
+        else:
+            p = mod.IvfFlatIndexParams(n_lists=n_lists, metric=args.metric)
+        build = mod.build_chunked if args.chunked else mod.build
+        src = np.asarray(base) if args.chunked else base
+        index = build(src, p)
+        probes = ([int(v) for v in args.sweep.split(",")] if args.sweep
+                  else [8, 16, 32, 64])
+        if args.index == "ivf_pq":
+            curve = sweep_ivf_pq(index, q, gt, args.k, probes,
+                                 refine_dataset=base if args.refine else None,
+                                 refine_ratio=max(args.refine, 1))
+        else:
+            curve = sweep_ivf_flat(index, q, gt, args.k, probes)
+    else:  # cagra
+        from raft_tpu.neighbors import cagra
+
+        p = cagra.CagraIndexParams(
+            intermediate_graph_degree=2 * args.graph_degree,
+            graph_degree=args.graph_degree, metric=args.metric,
+            build_algo="ivf" if n > 200_000 else "brute_force",
+            n_routers=max(128, min(1024, n // 2000)))
+        index = cagra.build(base, p)
+        grid = ([tuple(int(v) for v in pt.split(":")) for pt in args.sweep.split(",")]
+                if args.sweep else [(32, 4), (64, 4), (64, 8)])
+        curve = sweep_cagra(index, q, gt, args.k, grid)
+    build_s = round(time.time() - t0, 1)
+
+    for pt in curve:
+        print(json.dumps({"config": args.index, **pt}), flush=True)
+    best = best_at_recall(curve, args.recall_floor)
+    print(json.dumps({"summary": {
+        "index": args.index, "build_s": build_s,
+        "recall_floor": args.recall_floor,
+        "best": best,
+        "qps_at_floor": None if best is None else best["qps"]}}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
