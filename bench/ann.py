@@ -194,6 +194,12 @@ def sweep_cagra(index, queries, gt, k: int, grid, seed: int = 0
     return out
 
 
+def default_n_lists(n: int) -> int:
+    """The usual IVF starting point (tuning guide): ``2·sqrt(n)``, floored
+    at 64 — one home for the heuristic so the CLI and configs agree."""
+    return max(64, int(2 * np.sqrt(n)))
+
+
 def best_at_recall(curve: List[dict], floor: float = 0.95):
     """Highest-QPS point with recall ≥ floor (None if the curve never
     reaches it)."""

@@ -122,7 +122,9 @@ def main() -> None:
                                   "queries": int(q.shape[0]), "k": args.k}}),
           flush=True)
 
-    n_lists = args.n_lists or max(64, int(2 * np.sqrt(n)))
+    from ann import default_n_lists
+
+    n_lists = args.n_lists or default_n_lists(n)
     t0 = time.time()
     if args.index == "brute_force":
         from raft_tpu.neighbors import brute_force

@@ -16,7 +16,8 @@ from .resources import (
     set_comms,
     get_workspace_limit,
 )
-from .mesh import make_mesh, make_1d_mesh, local_mesh, distributed_init, DATA_AXIS, SHARD_AXIS
+from .mesh import (make_mesh, make_1d_mesh, make_hybrid_mesh, local_mesh,
+                   distributed_init, DATA_AXIS, SHARD_AXIS)
 from .array import wrap_array, check_rank, check_same_shape, check_dtype, to_numpy
 from .copy import copy
 from .bitset import Bitset, Bitmap, popc
@@ -37,7 +38,8 @@ __all__ = [
     "RaftError", "LogicError", "expects", "fail",
     "Resources", "DeviceResources", "default_resources", "set_default_resources",
     "get_mesh", "get_devices", "get_rng_key", "get_comms", "set_comms", "get_workspace_limit",
-    "make_mesh", "make_1d_mesh", "local_mesh", "distributed_init", "DATA_AXIS", "SHARD_AXIS",
+    "make_mesh", "make_1d_mesh", "make_hybrid_mesh", "local_mesh",
+    "distributed_init", "DATA_AXIS", "SHARD_AXIS",
     "wrap_array", "check_rank", "check_same_shape", "check_dtype", "to_numpy",
     "copy",
     "Bitset", "Bitmap", "popc",

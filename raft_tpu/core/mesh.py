@@ -24,6 +24,7 @@ from .errors import expects
 __all__ = [
     "make_mesh",
     "make_1d_mesh",
+    "make_hybrid_mesh",
     "local_mesh",
     "distributed_init",
     "DATA_AXIS",
@@ -55,6 +56,50 @@ def make_mesh(
     dev = np.asarray(devices)
     expects(dev.size == int(np.prod(shape)), f"need {int(np.prod(shape))} devices, have {dev.size}")
     return jax.sharding.Mesh(dev.reshape(tuple(shape)), tuple(axis_names))
+
+
+def make_hybrid_mesh(
+    dcn_axis: str = DATA_AXIS,
+    ici_axis: str = SHARD_AXIS,
+    dcn_size: Optional[int] = None,
+) -> jax.sharding.Mesh:
+    """Two-level mesh for multi-pod/multi-slice deployments: the outer axis
+    spans slices over **DCN** (data-center network), the inner axis spans
+    each slice's chips over **ICI**.
+
+    This is the topology-correct layout for the framework's sharded
+    indexes: put the index-shard axis (heavy all-gather/ppermute merges)
+    on ICI and the query/data-parallel axis (rare, small collectives) on
+    DCN — the mesh-axis-ordering recipe of SURVEY.md §5.8, replacing the
+    reference's NCCL-ring-over-IB assumptions
+    (``comms/std_comms.hpp:60``).
+
+    ``dcn_size`` defaults to ``jax.process_count()`` (one slice per
+    process); uses ``mesh_utils.create_hybrid_device_mesh`` when the
+    runtime exposes slice topology, falling back to a process-major
+    reshape (valid because ``jax.devices()`` orders by process).
+    """
+    n = len(jax.devices())
+    dcn = dcn_size or max(1, jax.process_count())
+    expects(n % dcn == 0, f"{n} devices not divisible by dcn size {dcn}")
+    try:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(1, n // dcn), dcn_mesh_shape=(dcn, 1))
+        dev_array = np.asarray(dev_array).reshape(dcn, n // dcn)
+    except Exception:
+        # the process-major reshape fallback is only topology-safe when the
+        # requested dcn grouping matches process boundaries (or everything
+        # is one process — CPU simulation); anything else would silently
+        # put the "ICI" axis across slices, the exact pathology this
+        # function exists to prevent
+        expects(jax.process_count() in (1, dcn),
+                f"runtime cannot form a hybrid mesh with dcn={dcn} over "
+                f"{jax.process_count()} processes; pass dcn_size="
+                f"{jax.process_count()} or build the mesh explicitly")
+        dev_array = np.asarray(jax.devices()).reshape(dcn, n // dcn)
+    return jax.sharding.Mesh(dev_array, (dcn_axis, ici_axis))
 
 
 def make_1d_mesh(axis_name: str = SHARD_AXIS, devices=None) -> jax.sharding.Mesh:

@@ -226,9 +226,16 @@ def knn(
 
 @functools.lru_cache(maxsize=64)
 def _sharded_knn_program(mesh: Mesh, axis: str, rows: int, k: int, kk: int,
-                         metric: str, tile: int, merge: str):
+                         metric: str, tile: int, merge: str,
+                         data_axis: Optional[str] = None):
     """Compile-once sharded search: jit keyed on the static config instead of
-    a per-call closure (which would re-trace every knn_sharded call)."""
+    a per-call closure (which would re-trace every knn_sharded call).
+
+    With ``data_axis`` (2-D mesh), queries are additionally partitioned
+    over that axis — each (data, shard) device scores its query block
+    against its database shard; merges stay on the shard axis (ICI), and
+    no collective crosses the data axis (DCN-safe when the data axis spans
+    slices; see ``core.mesh.make_hybrid_mesh``)."""
     nsh = mesh.shape[axis]
 
     def local(xq, ysh):
@@ -264,12 +271,13 @@ def _sharded_knn_program(mesh: Mesh, axis: str, rows: int, k: int, kk: int,
             out_v = -out_v
         return out_v, out_i
 
+    qspec = P(data_axis) if data_axis else P()
     return jax.jit(
         jax.shard_map(
             local,
             mesh=mesh,
-            in_specs=(P(), P(axis)),
-            out_specs=(P(), P()),
+            in_specs=(qspec, P(axis)),
+            out_specs=(qspec, qspec),
             check_vma=False,
         )
     )
@@ -282,6 +290,7 @@ def knn_sharded(
     *,
     mesh: Mesh,
     axis: str = "shard",
+    data_axis: Optional[str] = None,
     metric: str = "sqeuclidean",
     tile: int = 8192,
     merge: str = "gather",
@@ -295,6 +304,12 @@ def knn_sharded(
     (S−1 ppermute hops folding one neighbor's buffer at a time — constant
     memory, transfers overlap merges; the ring-attention-style pipeline for
     large k or many shards, :mod:`raft_tpu.comms.ring`).
+
+    On a 2-D mesh, ``data_axis`` additionally partitions the *queries*
+    over that axis (query-data-parallel × index-shard-parallel): merges
+    stay on the shard axis, nothing crosses the data axis — lay the data
+    axis over DCN and the shard axis over ICI
+    (:func:`raft_tpu.core.make_hybrid_mesh`).
     """
     x = wrap_array(queries, ndim=2, name="queries")
     y = wrap_array(database, ndim=2, name="database")
@@ -304,9 +319,14 @@ def knn_sharded(
     nsh = mesh.shape[axis]
     n = y.shape[0]
     expects(n % nsh == 0, f"database rows {n} not divisible by mesh axis {nsh}")
+    if data_axis is not None:
+        expects(data_axis in mesh.axis_names, f"axis {data_axis!r} not in mesh")
+        nd = mesh.shape[data_axis]
+        expects(x.shape[0] % nd == 0,
+                f"queries {x.shape[0]} not divisible by data axis {nd}")
     rows = n // nsh
     kk = min(k, rows)
     fn = _sharded_knn_program(mesh, axis, rows, int(k), kk, metric,
-                              int(min(tile, rows)), merge)
+                              int(min(tile, rows)), merge, data_axis)
     yb = y.reshape(nsh, rows, y.shape[1])
     return fn(x, yb)

@@ -435,12 +435,18 @@ class ShardedCagraIndex:
 
 def search_sharded(index: ShardedCagraIndex, queries, k: int,
                    params: Optional[CagraSearchParams] = None, *,
-                   mesh: Mesh, axis: str = "shard", seed: int = 0
+                   mesh: Mesh, axis: str = "shard",
+                   data_axis: Optional[str] = None, seed: int = 0
                    ) -> Tuple[jax.Array, jax.Array]:
     """Every shard searches its sub-graph with the same program; one
-    all_gather + select_k merges the per-shard top-k (ids globalized)."""
+    all_gather + select_k merges the per-shard top-k (ids globalized).
+    On a 2-D mesh, ``data_axis`` partitions the queries over that axis."""
     p = params or CagraSearchParams()
     q = wrap_array(queries, ndim=2, name="queries")
+    if data_axis is not None:
+        expects(data_axis in mesh.axis_names, f"axis {data_axis!r} not in mesh")
+        expects(q.shape[0] % int(mesh.shape[data_axis]) == 0,
+                "queries not divisible by data axis")
     itopk = max(p.itopk_size, k)
     iters = p.max_iterations or max(1, (itopk + p.search_width - 1)
                                     // p.search_width)
@@ -467,10 +473,11 @@ def search_sharded(index: ShardedCagraIndex, queries, k: int,
             fv = -fv
         return fv, fi
 
+    qspec = P(data_axis) if data_axis else P()
     return jax.jit(jax.shard_map(
         local, mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis), P(axis), P()),
-        out_specs=(P(), P()),
+        in_specs=(P(axis), P(axis), P(axis), P(axis), qspec),
+        out_specs=(qspec, qspec),
         check_vma=False,
     ))(index.datasets, index.graphs, index.router_centroids,
        index.router_nodes, q)

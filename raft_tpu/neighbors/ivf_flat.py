@@ -369,9 +369,11 @@ def build_sharded(dataset, mesh: Mesh, params: Optional[IvfFlatIndexParams] = No
     return IvfFlatIndex(c, data, ids, counts, norms, p.metric)
 
 
-@partial(jax.jit, static_argnames=("k", "n_probes", "metric", "axis", "mesh"))
+@partial(jax.jit, static_argnames=("k", "n_probes", "metric", "axis", "mesh",
+                                   "data_axis"))
 def _search_sharded_impl(mesh, axis, centroids, data, ids, counts, norms, q,
-                         k: int, n_probes: int, metric: str):
+                         k: int, n_probes: int, metric: str,
+                         data_axis: Optional[str] = None):
     def local(centroids_l, data_l, ids_l, counts_l, norms_l, q_l):
         bv, bi = _search_impl(centroids_l, data_l, ids_l, counts_l, norms_l,
                               q_l, k, n_probes, metric)
@@ -389,30 +391,39 @@ def _search_sharded_impl(mesh, axis, centroids, data, ids, counts, norms, q,
             fv = -fv
         return fv, fi
 
+    qspec = P(data_axis) if data_axis else P()
     return jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), P()),
-        out_specs=(P(), P()),
+        in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), qspec),
+        out_specs=(qspec, qspec),
         check_vma=False,
     )(centroids, data, ids, counts, norms, q)
 
 
 def search_sharded(index: IvfFlatIndex, queries, k: int,
                    params: Optional[IvfFlatSearchParams] = None, *,
-                   mesh: Mesh, axis: str = "shard"
+                   mesh: Mesh, axis: str = "shard",
+                   data_axis: Optional[str] = None
                    ) -> Tuple[jax.Array, jax.Array]:
     """Multi-chip search: each shard probes its local lists (n_probes per
     shard — recall ≥ single-chip at equal n_probes), one all_gather merges.
 
     Per-shard probing searches each shard's nearest local lists, so the union
-    over shards always covers the globally nearest lists.
+    over shards always covers the globally nearest lists.  On a 2-D mesh,
+    ``data_axis`` partitions the queries over that axis (merges stay on the
+    shard axis — see :func:`raft_tpu.core.make_hybrid_mesh`).
     """
     p = params or IvfFlatSearchParams()
     q = wrap_array(queries, ndim=2, name="queries")
     n_dev = int(mesh.shape[axis])
     local_lists = index.n_lists // n_dev
     n_probes = min(p.n_probes, local_lists)
+    if data_axis is not None:
+        expects(data_axis in mesh.axis_names, f"axis {data_axis!r} not in mesh")
+        expects(q.shape[0] % int(mesh.shape[data_axis]) == 0,
+                "queries not divisible by data axis")
     return _search_sharded_impl(mesh, axis, index.centroids, index.data,
                                 index.ids, index.counts, index.norms, q,
-                                int(k), int(n_probes), index.metric)
+                                int(k), int(n_probes), index.metric,
+                                data_axis)

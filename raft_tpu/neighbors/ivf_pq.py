@@ -583,10 +583,11 @@ def build_sharded(dataset, mesh, params: Optional[IvfPqIndexParams] = None,
 
 
 @partial(jax.jit, static_argnames=("k", "n_probes", "metric", "axis", "mesh",
-                                   "mode"))
+                                   "mode", "data_axis"))
 def _search_sharded_impl(mesh, axis, centroids, codebooks, codes, code_norms,
                          ids, counts, recon, recon_norms, q,
-                         k: int, n_probes: int, metric: str, mode: str):
+                         k: int, n_probes: int, metric: str, mode: str,
+                         data_axis: Optional[str] = None):
     from jax.sharding import PartitionSpec as P
 
     def merge(bv, bi, nq_l):
@@ -603,6 +604,7 @@ def _search_sharded_impl(mesh, axis, centroids, codebooks, codes, code_norms,
             fv = -fv
         return fv, fi
 
+    qspec = P(data_axis) if data_axis else P()
     if mode == "recon":
         def local(centroids_l, recon_l, recon_norms_l, ids_l, q_l):
             bv, bi = _search_recon_impl(centroids_l, recon_l, recon_norms_l,
@@ -611,8 +613,8 @@ def _search_sharded_impl(mesh, axis, centroids, codebooks, codes, code_norms,
 
         return jax.shard_map(
             local, mesh=mesh,
-            in_specs=(P(axis), P(axis), P(axis), P(axis), P()),
-            out_specs=(P(), P()), check_vma=False,
+            in_specs=(P(axis), P(axis), P(axis), P(axis), qspec),
+            out_specs=(qspec, qspec), check_vma=False,
         )(centroids, recon, recon_norms, ids, q)
 
     def local(centroids_l, codebooks_l, codes_l, code_norms_l, ids_l,
@@ -624,17 +626,20 @@ def _search_sharded_impl(mesh, axis, centroids, codebooks, codes, code_norms,
 
     return jax.shard_map(
         local, mesh=mesh,
-        in_specs=(P(axis), P(), P(axis), P(axis), P(axis), P(axis), P()),
-        out_specs=(P(), P()), check_vma=False,
+        in_specs=(P(axis), P(), P(axis), P(axis), P(axis), P(axis), qspec),
+        out_specs=(qspec, qspec), check_vma=False,
     )(centroids, codebooks, codes, code_norms, ids, counts, q)
 
 
 def search_sharded(index: IvfPqIndex, queries, k: int,
                    params: Optional[IvfPqSearchParams] = None, *,
-                   mesh, axis: str = "shard") -> Tuple[jax.Array, jax.Array]:
+                   mesh, axis: str = "shard",
+                   data_axis: Optional[str] = None
+                   ) -> Tuple[jax.Array, jax.Array]:
     """Multi-chip search: each shard probes its ``n_probes`` nearest
     *local* lists (union over shards covers the globally nearest lists),
-    one all_gather of (nq, k) candidates merges over ICI."""
+    one all_gather of (nq, k) candidates merges over ICI.  On a 2-D mesh,
+    ``data_axis`` partitions the queries over that axis."""
     p = params or IvfPqSearchParams()
     q = wrap_array(queries, ndim=2, name="queries")
     expects(q.shape[1] == index.dim, "query dim mismatch")
@@ -642,6 +647,10 @@ def search_sharded(index: IvfPqIndex, queries, k: int,
     n_dev = int(mesh.shape[axis])
     local_lists = index.n_lists // n_dev
     n_probes = min(p.n_probes, local_lists)
+    if data_axis is not None:
+        expects(data_axis in mesh.axis_names, f"axis {data_axis!r} not in mesh")
+        expects(q.shape[0] % int(mesh.shape[data_axis]) == 0,
+                "queries not divisible by data axis")
     mode = p.mode
     if mode == "auto":
         mode = "recon" if index.recon is not None else "lut"
@@ -652,4 +661,5 @@ def search_sharded(index: IvfPqIndex, queries, k: int,
     return _search_sharded_impl(mesh, axis, index.centroids, index.codebooks,
                                 index.codes, index.code_norms, index.ids,
                                 index.counts, index.recon, index.recon_norms,
-                                q, int(k), int(n_probes), index.metric, mode)
+                                q, int(k), int(n_probes), index.metric, mode,
+                                data_axis)

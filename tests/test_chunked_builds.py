@@ -207,3 +207,38 @@ class TestDistributedSharded:
         d, i = cagra.search_sharded(
             idx, q, 10, cagra.CagraSearchParams(itopk_size=32), mesh=mesh8)
         assert float(neighborhood_recall(np.asarray(i), gt)) > 0.9
+
+
+class TestDataParallelSearch:
+    """2-D (data x shard) mesh: queries partitioned over the data axis,
+    index over the shard axis — the hybrid ICI/DCN composition."""
+
+    def test_ivf_flat_2d(self, data, mesh2x4):
+        x, q, gt = data
+        idx = ivf_flat.build_sharded(x, mesh2x4,
+                                     ivf_flat.IvfFlatIndexParams(n_lists=32, seed=5))
+        sp = ivf_flat.IvfFlatSearchParams(n_probes=8)
+        _, i1 = ivf_flat.search_sharded(idx, q, 10, sp, mesh=mesh2x4)
+        _, i2 = ivf_flat.search_sharded(idx, q, 10, sp, mesh=mesh2x4,
+                                        data_axis="data")
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+    def test_ivf_pq_2d(self, data, mesh2x4):
+        x, q, gt = data
+        idx = ivf_pq.build_sharded(
+            x, mesh2x4, ivf_pq.IvfPqIndexParams(n_lists=32, pq_dim=16, seed=5))
+        sp = ivf_pq.IvfPqSearchParams(n_probes=8)
+        _, i1 = ivf_pq.search_sharded(idx, q, 10, sp, mesh=mesh2x4)
+        _, i2 = ivf_pq.search_sharded(idx, q, 10, sp, mesh=mesh2x4,
+                                      data_axis="data")
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+    def test_cagra_2d(self, data, mesh2x4):
+        x, q, gt = data
+        idx = cagra.build_sharded(x, mesh2x4, cagra.CagraIndexParams(
+            intermediate_graph_degree=32, graph_degree=16, n_routers=32))
+        sp = cagra.CagraSearchParams(itopk_size=32)
+        _, i1 = cagra.search_sharded(idx, q, 10, sp, mesh=mesh2x4)
+        _, i2 = cagra.search_sharded(idx, q, 10, sp, mesh=mesh2x4,
+                                     data_axis="data")
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))

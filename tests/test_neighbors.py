@@ -126,3 +126,22 @@ def test_knn_sharded_ring_k_exceeds_rows(rng, mesh8):
     d_ref, i_ref = knn(x, y, 5)
     d, i = knn_sharded(x, y, 5, mesh=mesh8, merge="ring")
     np.testing.assert_allclose(np.asarray(d), np.asarray(d_ref), rtol=1e-5)
+
+
+def test_knn_sharded_2d_mesh_data_parallel(rng, mesh2x4):
+    """Query-data-parallel x index-shard-parallel search on a 2-D mesh
+    (the hybrid ICI/DCN composition; collectives stay on the shard axis)."""
+    x = rng.standard_normal((512, 24)).astype(np.float32)
+    q = rng.standard_normal((64, 24)).astype(np.float32)
+    d_ref, i_ref = knn(q, x, 7)
+    d, i = knn_sharded(q, x, 7, mesh=mesh2x4, axis="shard", data_axis="data")
+    np.testing.assert_allclose(np.asarray(d), np.asarray(d_ref), rtol=1e-4, atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(i_ref))
+
+
+def test_make_hybrid_mesh_virtual(devices):
+    from raft_tpu.core import make_hybrid_mesh
+
+    mesh = make_hybrid_mesh(dcn_size=2)
+    assert mesh.axis_names == ("data", "shard")
+    assert mesh.shape["data"] == 2 and mesh.shape["shard"] == len(devices) // 2
