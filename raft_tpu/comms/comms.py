@@ -450,16 +450,21 @@ class SplitComms:
         return self.new_rank[rank]
 
     # Traced grouped verbs -------------------------------------------------
-    def t_allreduce(self, x, op: Op = Op.SUM):
-        """Traced grouped allreduce (call inside shard_map on parent axis)."""
+    def _group_mask(self):
+        """[size] bools: my group's members (traced; parent-axis context)."""
         size = self.parent.get_size()
         rank = lax.axis_index(self.axis)
-        gathered = lax.all_gather(x, self.axis)  # [size, ...]
         same = np.zeros((size, size), bool)
         for r in range(size):
             for q in self.group_ranks[r]:
                 same[r, q] = True
-        mask = jnp.asarray(same)[rank]  # [size] bools: my group members
+        return jnp.asarray(same)[rank]
+
+    def t_allreduce(self, x, op: Op = Op.SUM):
+        """Traced grouped allreduce (call inside shard_map on parent axis)."""
+        size = self.parent.get_size()
+        gathered = lax.all_gather(x, self.axis)  # [size, ...]
+        mask = self._group_mask()
         shaped = mask.reshape((size,) + (1,) * (gathered.ndim - 1))
         if op == Op.SUM:
             return jnp.sum(jnp.where(shaped, gathered, 0), axis=0)
@@ -471,10 +476,72 @@ class SplitComms:
             return jnp.min(jnp.where(shaped, gathered, pos), axis=0)
         return jnp.prod(jnp.where(shaped, gathered, 1), axis=0)
 
+    def _group_root(self, root: int) -> np.ndarray:
+        """[size] parent rank of each rank's group ``root`` (group-local,
+        key-ordered).  ``root`` is validated against every group's size —
+        an out-of-range root is an error, as in MPI/NCCL."""
+        size = self.parent.get_size()
+        expects(0 <= root < min(len(g) for g in self.group_ranks),
+                f"root {root} out of range for the smallest group")
+        src = np.zeros((size,), np.int32)
+        for r in range(size):
+            src[r] = self.group_ranks[r][root]
+        return src
+
+    def t_bcast(self, x, root: int = 0):
+        """Traced grouped bcast: every rank receives its group's ``root``-th
+        member's value (root indexes *within* the group, by key order)."""
+        rank = lax.axis_index(self.axis)
+        gathered = lax.all_gather(x, self.axis)  # [size, ...]
+        return gathered[jnp.asarray(self._group_root(root))[rank]]
+
+    def t_reduce(self, x, op: Op = Op.SUM, root: int = 0):
+        """Traced grouped reduce: the group root gets the reduction, other
+        ranks get zeros — same non-root contract as the parent-axis
+        :func:`reduce` (the reference leaves them undefined)."""
+        rank = lax.axis_index(self.axis)
+        red = self.t_allreduce(x, op)
+        src = jnp.asarray(self._group_root(root))[rank]
+        return jnp.where(rank == src, red, jnp.zeros_like(red))
+
+    def t_allgather(self, x):
+        """Traced grouped allgather: [max_group_size, ...] per rank, rows
+        ordered by group key; groups smaller than the largest repeat their
+        last member (defined-prefix contract — read the first
+        ``get_size_of(rank)`` rows, like allgatherv)."""
+        size = self.parent.get_size()
+        rank = lax.axis_index(self.axis)
+        gathered = lax.all_gather(x, self.axis)  # [size, ...]
+        gmax = max(len(g) for g in self.group_ranks)
+        members = np.zeros((size, gmax), np.int32)
+        for r in range(size):
+            g = self.group_ranks[r]
+            members[r] = [g[min(i, len(g) - 1)] for i in range(gmax)]
+        return gathered[jnp.asarray(members)[rank]]
+
+    # Eager wrappers (parent-cached programs) ------------------------------
+    def _key(self, verb, *extra):
+        return ("split_" + verb, tuple(self.color), tuple(self.key)) + extra
+
     def allreduce(self, x, op: Op = Op.SUM):
         return self.parent._run(
-            ("split_allreduce", tuple(self.color), tuple(self.key), op),
+            self._key("allreduce", op),
             lambda v: self.t_allreduce(v[0], op)[None], x)
+
+    def bcast(self, x, root: int = 0):
+        return self.parent._run(
+            self._key("bcast", root),
+            lambda v: self.t_bcast(v[0], root)[None], x)
+
+    def reduce(self, x, op: Op = Op.SUM, root: int = 0):
+        return self.parent._run(
+            self._key("reduce", op, root),
+            lambda v: self.t_reduce(v[0], op, root)[None], x)
+
+    def allgather(self, x):
+        return self.parent._run(
+            self._key("allgather"),
+            lambda v: self.t_allgather(v[0])[None], x)
 
 
 def build_comms(mesh: Mesh, axis: Optional[str] = None) -> Comms:

@@ -54,10 +54,14 @@ def _kernel(x_ref, val_ref, idx_ref, *, k: int, kpad: int, bn: int, length: int)
     width = kpad + bn
     lane = jax.lax.broadcasted_iota(jnp.int32, (bm, width), 1)
 
-    new_val = jnp.full((bm, kpad), jnp.inf, jnp.float32)
-    new_idx = jnp.full((bm, kpad), -1, jnp.int32)
     kslot = jax.lax.broadcasted_iota(jnp.int32, (bm, kpad), 1)
-    for s in range(k):
+
+    # rolled (not Python-unrolled) min-extraction: k unrolled passes blow
+    # up the Mosaic program at k ≳ 16 over wide blocks (the tuner observed
+    # compile failures at k=32, cols ≥ 16384); a fori_loop keeps the
+    # program size O(1) in k
+    def pass_s(s, carry):
+        cat_val, new_val, new_idx = carry
         m = jnp.min(cat_val, axis=1)                          # (BM,)
         am = jnp.argmin(cat_val, axis=1)                      # (BM,)
         hit = lane == am[:, None]                             # exactly one per row
@@ -65,6 +69,13 @@ def _kernel(x_ref, val_ref, idx_ref, *, k: int, kpad: int, bn: int, length: int)
         new_val = jnp.where(kslot == s, m[:, None], new_val)
         new_idx = jnp.where(kslot == s, mi[:, None], new_idx)
         cat_val = jnp.where(hit, jnp.inf, cat_val)
+        return cat_val, new_val, new_idx
+
+    _, new_val, new_idx = jax.lax.fori_loop(
+        0, k, pass_s,
+        (cat_val,
+         jnp.full((bm, kpad), jnp.inf, jnp.float32),
+         jnp.full((bm, kpad), -1, jnp.int32)))
     val_ref[:] = new_val
     idx_ref[:] = new_idx
 
