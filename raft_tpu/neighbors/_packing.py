@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["pack_lists", "chunked_queries", "scatter_append",
-           "shard_rows", "sharded_train_sizes"]
+           "scatter_append_copy", "shard_rows", "sharded_train_sizes"]
 
 
 def shard_rows(dataset, mesh, axis: str):
@@ -131,8 +131,7 @@ def pack_lists(
     return tuple(packed), jnp.minimum(counts, cap)
 
 
-@partial(jax.jit, static_argnames=("n_lists", "cap"), donate_argnums=(0, 1))
-def scatter_append(
+def _scatter_append_impl(
     slabs: Tuple[jax.Array, ...],
     counts: jax.Array,
     labels: jax.Array,
@@ -145,10 +144,15 @@ def scatter_append(
 
     The streaming counterpart of :func:`pack_lists`: rows labeled ``l`` land
     at positions ``counts[l] + rank-within-chunk``, so successive calls build
-    the same layout ``pack_lists`` would have produced in one shot.  ``slabs``
-    and ``counts`` are **donated** — the update is in-place (peak device
-    memory stays slab + chunk, which is what makes larger-than-HBM datasets
-    buildable chunk by chunk; VERDICT r2 missing #2).
+    the same layout ``pack_lists`` would have produced in one shot.
+
+    Two jitted forms: :func:`scatter_append` **donates** ``slabs`` and
+    ``counts`` — in-place update, peak device memory stays slab + chunk
+    (what makes larger-than-HBM datasets buildable chunk by chunk; VERDICT
+    r2 missing #2) — callers must own the buffers (build loops do).
+    :func:`scatter_append_copy` leaves the inputs alive, for callers
+    updating a LIVE index's arrays (``ivf_pq.extend``) where donation
+    would delete the source index's buffers out from under it.
 
     ``labels``: (chunk,) int32, −1 = drop (callers cap against remaining
     room via :func:`raft_tpu.cluster.kmeans.capped_assign_room`, so −1 only
@@ -176,3 +180,9 @@ def scatter_append(
         out.append(flat.reshape(slab.shape))
     new_counts = jnp.minimum(counts + added, cap)
     return tuple(out), new_counts.astype(jnp.int32)
+
+
+scatter_append = partial(jax.jit, static_argnames=("n_lists", "cap"),
+                         donate_argnums=(0, 1))(_scatter_append_impl)
+scatter_append_copy = partial(jax.jit, static_argnames=("n_lists", "cap"))(
+    _scatter_append_impl)

@@ -81,13 +81,16 @@ def tile_knn_merge(best_val, best_idx, tile_val, tile_idx, k: int):
 
 
 @partial(jax.jit, static_argnames=("k", "metric", "tile"))
-def _knn_impl(x, y, k: int, metric: str, tile: int) -> Tuple[jax.Array, jax.Array]:
+def _knn_impl(x, y, k: int, metric: str, tile: int,
+              keep=None) -> Tuple[jax.Array, jax.Array]:
     m, d = x.shape
     n = y.shape[0]
     pad = (-n) % tile
     if pad:
         y = jnp.concatenate([y, jnp.zeros((pad, d), y.dtype)], axis=0)
     ytiles = y.reshape(-1, tile, d)
+    if keep is not None:  # bitset/bool prefilter: False rows never rank
+        keep_t = jnp.pad(keep, (0, pad), constant_values=False).reshape(-1, tile)
     xf = x.astype(jnp.float32)
     xn = jnp.sum(xf * xf, axis=1)
 
@@ -98,7 +101,10 @@ def _knn_impl(x, y, k: int, metric: str, tile: int) -> Tuple[jax.Array, jax.Arra
         t, yt = inp
         dist = _tile_distances(x, yt, metric, xn)
         col = t * tile + jnp.arange(tile)
-        dist = jnp.where(col[None, :] < n, dist, jnp.inf)
+        valid = col[None, :] < n
+        if keep is not None:
+            valid = valid & keep_t[t][None, :]
+        dist = jnp.where(valid, dist, jnp.inf)
         neg, loc = jax.lax.top_k(-dist, kk)
         tv, ti = -neg, t * tile + loc
         return tile_knn_merge(best_val, best_idx, tv, ti, k), None
@@ -130,11 +136,15 @@ def _exact_candidate_distances(x, yc, metric: str):
 
 
 @partial(jax.jit, static_argnames=("k", "metric", "cand", "bm", "bn"))
-def _fast_knn_impl(x, y, k: int, metric: str, cand: int, bm: int, bn: int):
+def _fast_knn_impl(x, y, k: int, metric: str, cand: int, bm: int, bn: int,
+                   keep=None):
     """bf16 shortlist (fused Pallas kernel on TPU, XLA approx_max_k
     elsewhere) + exact f32 refine.  Smaller-is-nearer surrogate:
     ``‖y‖² − 2·x·yᵀ`` for L2/cosine-normalized data, ``−x·yᵀ`` for
-    inner product (yn ≡ 0)."""
+    inner product (yn ≡ 0).  The prefilter rides the norm vector: a
+    filtered row's ``yn = +inf`` makes its surrogate +inf, so it can
+    never enter the shortlist (and the refine's isfinite guard drops
+    any that slip through a padded slot)."""
     m, d = x.shape
     n = y.shape[0]
     if metric == "cosine":
@@ -147,6 +157,8 @@ def _fast_knn_impl(x, y, k: int, metric: str, cand: int, bm: int, bn: int):
     else:
         ysf = ys.astype(jnp.float32)
         yn = jnp.sum(ysf * ysf, axis=1)
+    if keep is not None:
+        yn = jnp.where(keep, yn, jnp.inf)
 
     cand = min(cand, n)
     if jax.default_backend() == "tpu":
@@ -195,6 +207,24 @@ def _fast_knn_impl(x, y, k: int, metric: str, cand: int, bm: int, bn: int):
     return vals, jnp.take_along_axis(short, p2, axis=1)
 
 
+def _as_keep_mask(filter, n=None):
+    """Normalize a prefilter (``core.Bitset`` or boolean array, True/1 =
+    keep) to a bool vector — the ``cuvs bitset_filter`` contract.  With
+    ``n`` the length is checked exactly (positional row numbering); IVF
+    callers pass ``n=None`` because their filter indexes *source ids*,
+    which may be sparse/custom."""
+    if filter is None:
+        return None
+    from ..core.bitset import Bitset
+
+    keep = filter.to_bool_array() if isinstance(filter, Bitset) else \
+        jnp.asarray(filter, bool)
+    expects(keep.ndim == 1, "filter must be 1-D")
+    if n is not None:
+        expects(keep.shape == (n,), f"filter covers {keep.shape}, need ({n},)")
+    return keep
+
+
 def knn(
     queries,
     database,
@@ -204,6 +234,7 @@ def knn(
     tile: int = 8192,
     mode: str = "exact",
     cand: int = 64,
+    filter=None,
     res=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """kNN: returns ``(distances, indices)`` of shape (n_queries, k),
@@ -211,17 +242,32 @@ def knn(
     inner_product}.  ``mode="exact"`` (default) or ``"fast"`` (bf16 MXU
     shortlist + exact refine; recall@k ≥ ~0.999, ~3.5× faster — see
     module docstring).  ``cand`` is the fast-mode shortlist width
-    (≥ 4·k recommended)."""
+    (≥ 4·k recommended).
+
+    ``filter``: optional prefilter (``core.Bitset`` or (n,) bools, True =
+    keep) — filtered database rows never appear in results (cuVS
+    bitset-filtered search parity).  If fewer than k rows pass, the tail
+    carries id −1 with ±inf distance.
+    """
     x = wrap_array(queries, ndim=2, name="queries")
     y = wrap_array(database, ndim=2, name="database")
     expects(x.shape[1] == y.shape[1], f"dim mismatch {x.shape} vs {y.shape}")
     expects(k >= 1, "k must be >= 1")
     expects(k <= y.shape[0], f"k={k} exceeds database size {y.shape[0]}")
     expects(mode in ("exact", "fast"), f"unknown mode {mode!r}")
+    keep = _as_keep_mask(filter, y.shape[0])
     if mode == "fast":
-        return _fast_knn_impl(x, y, int(k), metric, int(max(cand, k)),
-                              1024, 1024)
-    return _knn_impl(x, y, int(k), metric, int(min(tile, max(y.shape[0], 1))))
+        vals, ids = _fast_knn_impl(x, y, int(k), metric, int(max(cand, k)),
+                                   1024, 1024, keep)
+    else:
+        vals, ids = _knn_impl(x, y, int(k), metric,
+                              int(min(tile, max(y.shape[0], 1))), keep)
+    if keep is not None:
+        # contract: filtered rows never surface, even as inf-distance tail
+        # padding when fewer than k rows pass (±inf: IP similarities come
+        # back negated, so masked slots are -inf there)
+        ids = jnp.where(jnp.isfinite(vals), ids, -1)
+    return vals, ids
 
 
 @functools.lru_cache(maxsize=64)

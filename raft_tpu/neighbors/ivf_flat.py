@@ -235,12 +235,13 @@ def extend(index: IvfFlatIndex, new_vectors, new_ids=None) -> IvfFlatIndex:
                         index.metric)
 
 
-def _probe_scan(q, qn, data, ids, counts, norms, probes, k: int, metric: str):
+def _probe_scan(q, qn, data, ids, counts, norms, probes, k: int, metric: str,
+                keep=None):
     """Scan probe ranks, merging each probed list into the running top-k.
 
     q: [nq, d]; probes: [nq, P].  One iteration gathers the p-th probed list
     of every query ([nq, cap, d] slab) and computes the distance block with a
-    batched MXU dot.
+    batched MXU dot.  ``keep``: optional (n,) bool prefilter by source id.
     """
     nq = q.shape[0]
     cap = data.shape[1]
@@ -262,7 +263,10 @@ def _probe_scan(q, qn, data, ids, counts, norms, probes, k: int, metric: str):
             dist = norms[lists] - 2.0 * dots + qn[:, None]
             dist = jnp.maximum(dist, 0.0)
         valid = jnp.arange(cap)[None, :] < counts[lists][:, None]
-        dist = jnp.where(valid & (vids >= 0), dist, jnp.inf)
+        valid = valid & (vids >= 0)
+        if keep is not None:
+            valid = valid & keep[jnp.maximum(vids, 0)]
+        dist = jnp.where(valid, dist, jnp.inf)
         return tile_knn_merge(best_val, best_idx, dist, vids, k), None
 
     init = (jnp.full((nq, k), jnp.inf, jnp.float32),
@@ -273,12 +277,13 @@ def _probe_scan(q, qn, data, ids, counts, norms, probes, k: int, metric: str):
 
 @partial(jax.jit, static_argnames=("k", "n_probes", "metric"))
 def _search_impl(centroids, data, ids, counts, norms, q, k: int,
-                 n_probes: int, metric: str):
+                 n_probes: int, metric: str, keep=None):
     qf = q.astype(jnp.float32)
     qn = jnp.sum(qf * qf, axis=1)
     cd = sq_l2(q, centroids)                      # [nq, L] MXU block
     _, probes = jax.lax.top_k(-cd, n_probes)      # nearest lists
-    bv, bi = _probe_scan(q, qn, data, ids, counts, norms, probes, k, metric)
+    bv, bi = _probe_scan(q, qn, data, ids, counts, norms, probes, k, metric,
+                         keep)
     if metric == "euclidean":
         bv = jnp.sqrt(jnp.maximum(bv, 0.0))
     elif metric == "inner_product":
@@ -287,19 +292,33 @@ def _search_impl(centroids, data, ids, counts, norms, q, k: int,
 
 
 def search(index: IvfFlatIndex, queries, k: int,
-           params: Optional[IvfFlatSearchParams] = None, *, res=None
-           ) -> Tuple[jax.Array, jax.Array]:
-    """Approximate kNN: returns ``(distances, ids)`` of (nq, k), best first."""
+           params: Optional[IvfFlatSearchParams] = None, *, filter=None,
+           res=None) -> Tuple[jax.Array, jax.Array]:
+    """Approximate kNN: returns ``(distances, ids)`` of (nq, k), best first.
+
+    ``filter``: optional prefilter by source id (``core.Bitset`` or bools
+    over the ORIGINAL row numbering, True = keep) — cuVS bitset-filtered
+    search parity."""
+    from .brute_force import _as_keep_mask
+
     p = params or IvfFlatSearchParams()
     q = wrap_array(queries, ndim=2, name="queries")
     expects(q.shape[1] == index.dim, "query dim mismatch")
     n_probes = min(p.n_probes, index.n_lists)
+    keep = _as_keep_mask(filter)  # indexes source ids (may be custom)
+    if keep is not None:
+        # necessary bound even for custom ids: |ids| distinct ⇒ max ≥ size−1
+        expects(keep.shape[0] >= index.size,
+                f"filter covers {keep.shape[0]} ids, index holds {index.size}")
     from ._packing import chunked_queries
 
     run = lambda qc: _search_impl(index.centroids, index.data, index.ids,
                                   index.counts, index.norms, qc, int(k),
-                                  int(n_probes), index.metric)
-    return chunked_queries(run, q, int(p.query_chunk))
+                                  int(n_probes), index.metric, keep)
+    dv, di = chunked_queries(run, q, int(p.query_chunk))
+    if keep is not None:  # sub-k survivors: sentinel tail, not real ids
+        di = jnp.where(jnp.isfinite(dv), di, -1)
+    return dv, di
 
 
 # ---------------------------------------------------------------------------

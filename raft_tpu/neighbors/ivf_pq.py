@@ -50,6 +50,7 @@ __all__ = [
     "IvfPqIndex",
     "build",
     "build_chunked",
+    "extend",
     "search",
     "build_sharded",
     "search_sharded",
@@ -274,6 +275,48 @@ def build(dataset, params: Optional[IvfPqIndexParams] = None, *,
     return index.with_recon() if p.store_recon else index
 
 
+def extend(index: IvfPqIndex, new_vectors, new_ids=None) -> IvfPqIndex:
+    """Append vectors to an existing index (cuVS ``extend`` parity): encode
+    against the trained centroids/codebooks and scatter-append into the
+    code slabs, growing list capacity when the new rows overflow it.  The
+    derived recon tier is rebuilt when the source index carried one."""
+    from ..cluster.kmeans import capped_assign_room
+    from ._packing import scatter_append_copy
+
+    x = wrap_array(new_vectors, ndim=2)
+    expects(x.shape[1] == index.dim, "vector dim mismatch")
+    m = index.pq_dim
+    L, cap = index.n_lists, index.list_cap
+    ids = (jnp.asarray(new_ids, jnp.int32) if new_ids is not None
+           else jnp.arange(index.size, index.size + x.shape[0],
+                           dtype=jnp.int32))
+
+    # grow capacity so every new row fits its nearest list (static shape:
+    # computed on host from a plain assignment histogram)
+    labels0 = jnp.argmin(sq_l2(x, index.centroids), axis=1)
+    added = jax.ops.segment_sum(jnp.ones_like(labels0, jnp.int32), labels0,
+                                num_segments=L)
+    new_cap = max(cap, int(jnp.max(index.counts + added)))
+    pad = new_cap - cap
+    codes = jnp.pad(index.codes, ((0, 0), (0, pad), (0, 0))) if pad else index.codes
+    cnorms = jnp.pad(index.code_norms, ((0, 0), (0, pad))) if pad else index.code_norms
+    slab_ids = (jnp.pad(index.ids, ((0, 0), (0, pad)), constant_values=-1)
+                if pad else index.ids)
+
+    labels, _ = capped_assign_room(x, index.centroids,
+                                   new_cap - index.counts)
+    residuals = x - index.centroids[jnp.clip(labels, 0, L - 1)]
+    ch_codes, ch_norms = _encode(residuals, index.codebooks, m)
+    # non-donating form: the inputs may alias the LIVE source index's
+    # buffers (donation would delete them out from under `index`)
+    (codes, cnorms, slab_ids), counts = scatter_append_copy(
+        (codes, cnorms, slab_ids), index.counts, labels,
+        (ch_codes, ch_norms, ids), n_lists=L, cap=new_cap)
+    out = IvfPqIndex(index.centroids, index.codebooks, codes, cnorms,
+                     slab_ids, counts, index.metric)
+    return out.with_recon() if index.recon is not None else out
+
+
 def build_chunked(dataset, params: Optional[IvfPqIndexParams] = None, *,
                   chunk_rows: int = 65536, source_ids=None,
                   res=None) -> IvfPqIndex:
@@ -346,7 +389,7 @@ def build_chunked(dataset, params: Optional[IvfPqIndexParams] = None, *,
 
 @partial(jax.jit, static_argnames=("k", "n_probes", "metric"))
 def _search_recon_impl(centroids, recon, recon_norms, ids, q,
-                       k: int, n_probes: int, metric: str):
+                       k: int, n_probes: int, metric: str, keep=None):
     nq, d = q.shape
     cap = recon.shape[1]
     qf = q.astype(jnp.float32)
@@ -367,6 +410,8 @@ def _search_recon_impl(centroids, recon, recon_norms, ids, q,
         else:
             # recon_norms carries +inf on pad entries — they self-mask
             dist = qn[:, None] - 2.0 * dots + recon_norms[lists]
+        if keep is not None:  # prefilter by source id (True = keep)
+            dist = jnp.where(keep[jnp.maximum(vids, 0)], dist, jnp.inf)
         return tile_knn_merge(best_val, best_idx, dist, vids, k), None
 
     init = (jnp.full((nq, k), jnp.inf, jnp.float32),
@@ -386,7 +431,7 @@ def _search_recon_impl(centroids, recon, recon_norms, ids, q,
 
 @partial(jax.jit, static_argnames=("k", "n_probes", "metric"))
 def _search_lut_impl(centroids, codebooks, codes, code_norms, ids, counts, q,
-                     k: int, n_probes: int, metric: str):
+                     k: int, n_probes: int, metric: str, keep=None):
     nq, d = q.shape
     m, c, ds = codebooks.shape
     cap = codes.shape[1]
@@ -428,7 +473,10 @@ def _search_lut_impl(centroids, codebooks, codes, code_norms, ids, counts, q,
             dist = -(qc_sel + ip_q)
         valid = jnp.arange(cap)[None, :] < counts[lists][:, None]
         vids = ids[lists]
-        dist = jnp.where(valid & (vids >= 0), dist, jnp.inf)
+        valid = valid & (vids >= 0)
+        if keep is not None:  # prefilter by source id (True = keep)
+            valid = valid & keep[jnp.maximum(vids, 0)]
+        dist = jnp.where(valid, dist, jnp.inf)
         return tile_knn_merge(best_val, best_idx, dist, vids, k), None
 
     init = (jnp.full((nq, k), jnp.inf, jnp.float32),
@@ -442,15 +490,25 @@ def _search_lut_impl(centroids, codebooks, codes, code_norms, ids, counts, q,
 
 
 def search(index: IvfPqIndex, queries, k: int,
-           params: Optional[IvfPqSearchParams] = None, *, res=None
-           ) -> Tuple[jax.Array, jax.Array]:
+           params: Optional[IvfPqSearchParams] = None, *, filter=None,
+           res=None) -> Tuple[jax.Array, jax.Array]:
     """Approximate kNN over the PQ index; combine with
-    :func:`raft_tpu.neighbors.refine.refine` for exact re-ranking."""
+    :func:`raft_tpu.neighbors.refine.refine` for exact re-ranking.
+
+    ``filter``: optional prefilter by source id (``core.Bitset`` or bools,
+    True = keep) — cuVS bitset-filtered search parity."""
+    from .brute_force import _as_keep_mask
+
     p = params or IvfPqSearchParams()
     q = wrap_array(queries, ndim=2, name="queries")
     expects(q.shape[1] == index.dim, "query dim mismatch")
     expects(p.mode in ("auto", "recon", "lut"), f"unknown mode {p.mode!r}")
     n_probes = min(p.n_probes, index.n_lists)
+    keep = _as_keep_mask(filter)  # indexes source ids (may be custom)
+    if keep is not None:
+        # necessary bound even for custom ids: |ids| distinct ⇒ max ≥ size−1
+        expects(keep.shape[0] >= index.size,
+                f"filter covers {keep.shape[0]} ids, index holds {index.size}")
     mode = p.mode
     if mode == "auto":
         mode = "recon" if index.recon is not None else "lut"
@@ -460,12 +518,16 @@ def search(index: IvfPqIndex, queries, k: int,
                 "index.with_recon() (e.g. after load_index)")
         run = lambda qc: _search_recon_impl(
             index.centroids, index.recon, index.recon_norms, index.ids,
-            qc, int(k), int(n_probes), index.metric)
+            qc, int(k), int(n_probes), index.metric, keep)
     else:
         run = lambda qc: _search_lut_impl(
             index.centroids, index.codebooks, index.codes, index.code_norms,
-            index.ids, index.counts, qc, int(k), int(n_probes), index.metric)
-    return chunked_queries(run, q, int(p.query_chunk))
+            index.ids, index.counts, qc, int(k), int(n_probes), index.metric,
+            keep)
+    dv, di = chunked_queries(run, q, int(p.query_chunk))
+    if keep is not None:  # sub-k survivors: sentinel tail, not real ids
+        di = jnp.where(jnp.isfinite(dv), di, -1)
+    return dv, di
 
 
 # ---------------------------------------------------------------------------

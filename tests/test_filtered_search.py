@@ -1,0 +1,154 @@
+"""Bitset-prefiltered search (cuVS filtered-ANN parity: filter bit = keep)
+and IVF-PQ extend."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq
+from raft_tpu.stats import neighborhood_recall
+
+
+@pytest.fixture(scope="module")
+def fdata():
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal((3000, 24)).astype(np.float32)
+    q = rng.standard_normal((64, 24)).astype(np.float32)
+    keep = rng.random(3000) < 0.5
+    # exact filtered reference: brute force over the kept subset
+    sub = np.where(keep)[0]
+    _, gt_sub = brute_force.knn(q, x[sub], 10)
+    gt = sub[np.asarray(gt_sub)]
+    return x, q, keep, gt
+
+
+class TestFilteredBruteForce:
+    def test_exact_mode_matches_subset_search(self, fdata):
+        x, q, keep, gt = fdata
+        _, ids = brute_force.knn(q, x, 10, filter=keep)
+        np.testing.assert_array_equal(np.asarray(ids), gt)
+
+    def test_bitset_filter_equivalent(self, fdata):
+        x, q, keep, gt = fdata
+        bs = Bitset.from_bool_array(keep)
+        _, ids = brute_force.knn(q, x, 10, filter=bs)
+        np.testing.assert_array_equal(np.asarray(ids), gt)
+
+    def test_fast_mode_filtered_recall(self, fdata):
+        x, q, keep, gt = fdata
+        _, ids = brute_force.knn(q, x, 10, mode="fast", filter=keep)
+        ids = np.asarray(ids)
+        assert not np.isin(ids, np.where(~keep)[0]).any()
+        assert float(neighborhood_recall(ids, gt)) > 0.95
+
+    def test_filter_length_checked(self, fdata):
+        x, q, _, _ = fdata
+        with pytest.raises(Exception):
+            brute_force.knn(q, x, 10, filter=np.ones(10, bool))
+
+
+class TestFilteredIvf:
+    def test_ivf_flat_filter_excludes(self, fdata):
+        x, q, keep, gt = fdata
+        idx = ivf_flat.build(x, ivf_flat.IvfFlatIndexParams(n_lists=16))
+        sp = ivf_flat.IvfFlatSearchParams(n_probes=16)  # exhaustive probes
+        _, ids = ivf_flat.search(idx, q, 10, sp, filter=keep)
+        ids = np.asarray(ids)
+        assert not np.isin(ids, np.where(~keep)[0]).any()
+        assert float(neighborhood_recall(ids, gt)) > 0.95
+
+    def test_ivf_pq_filter_excludes(self, fdata):
+        x, q, keep, gt = fdata
+        idx = ivf_pq.build(x, ivf_pq.IvfPqIndexParams(n_lists=16, pq_dim=12))
+        sp = ivf_pq.IvfPqSearchParams(n_probes=16)
+        for mode in ("recon", "lut"):
+            sp2 = ivf_pq.IvfPqSearchParams(n_probes=16, mode=mode)
+            _, ids = ivf_pq.search(idx, q, 10, sp2, filter=keep)
+            assert not np.isin(np.asarray(ids), np.where(~keep)[0]).any()
+
+
+class TestIvfPqExtend:
+    def test_extend_appends_and_searches(self):
+        rng = np.random.default_rng(5)
+        x1 = rng.standard_normal((2000, 16)).astype(np.float32)
+        x2 = rng.standard_normal((500, 16)).astype(np.float32)
+        idx = ivf_pq.build(x1, ivf_pq.IvfPqIndexParams(n_lists=8, pq_dim=8))
+        ext = ivf_pq.extend(idx, x2)
+        assert ext.size == 2500
+        # new rows are findable: search for them, ids land in [2000, 2500)
+        sp = ivf_pq.IvfPqSearchParams(n_probes=8)
+        _, ids = ivf_pq.search(ext, x2[:32], 1, sp)
+        hits = (np.asarray(ids)[:, 0] >= 2000).mean()
+        assert hits > 0.8
+
+    def test_extend_grows_capacity(self):
+        rng = np.random.default_rng(6)
+        x1 = rng.standard_normal((400, 16)).astype(np.float32)
+        # skew: all new rows near one point → one list must grow
+        x2 = np.tile(x1[:1], (300, 1)) + 0.01 * rng.standard_normal(
+            (300, 16)).astype(np.float32)
+        idx = ivf_pq.build(x1, ivf_pq.IvfPqIndexParams(
+            n_lists=8, pq_dim=8, list_cap_ratio=1.2))
+        ext = ivf_pq.extend(idx, x2)
+        assert ext.size == 700
+        assert ext.list_cap > idx.list_cap
+
+    def test_extend_without_recon_stays_lut(self):
+        rng = np.random.default_rng(7)
+        x = rng.standard_normal((500, 16)).astype(np.float32)
+        idx = ivf_pq.build(x, ivf_pq.IvfPqIndexParams(
+            n_lists=8, pq_dim=8, store_recon=False))
+        ext = ivf_pq.extend(idx, x[:100])
+        assert ext.recon is None and ext.size == 600
+
+
+class TestSubKFilter:
+    """Fewer passing rows than k: tails must be (-1, ±inf), never real
+    filtered ids."""
+
+    def test_brute_force_exact_and_fast(self, fdata):
+        x, q, _, _ = fdata
+        keep = np.zeros(x.shape[0], bool)
+        keep[:3] = True
+        for mode in ("exact", "fast"):
+            d, ids = brute_force.knn(q, x, 10, mode=mode, filter=keep)
+            ids = np.asarray(ids)
+            assert set(np.unique(ids[:, 3:])) == {-1}
+            assert set(np.unique(ids[:, :3])) <= {0, 1, 2}
+
+    def test_brute_force_inner_product(self, fdata):
+        x, q, _, _ = fdata
+        keep = np.zeros(x.shape[0], bool)
+        keep[:2] = True
+        d, ids = brute_force.knn(q, x, 5, metric="inner_product", filter=keep)
+        assert set(np.unique(np.asarray(ids)[:, 2:])) == {-1}
+
+    def test_ivf_flat_sub_k(self, fdata):
+        x, q, _, _ = fdata
+        keep = np.zeros(x.shape[0], bool)
+        keep[:3] = True
+        idx = ivf_flat.build(x, ivf_flat.IvfFlatIndexParams(n_lists=16))
+        _, ids = ivf_flat.search(
+            idx, q, 10, ivf_flat.IvfFlatSearchParams(n_probes=16), filter=keep)
+        assert not np.isin(np.asarray(ids), np.arange(3, x.shape[0])).any()
+
+    def test_short_filter_rejected_ivf(self, fdata):
+        x, q, _, _ = fdata
+        idx = ivf_flat.build(x, ivf_flat.IvfFlatIndexParams(n_lists=16))
+        with pytest.raises(Exception):
+            ivf_flat.search(idx, q, 10, filter=np.ones(10, bool))
+
+
+class TestExtendPreservesSource:
+    def test_source_index_usable_after_extend(self):
+        """extend must not donate the live source index's buffers."""
+        rng = np.random.default_rng(8)
+        x = rng.standard_normal((600, 16)).astype(np.float32)
+        idx = ivf_pq.build(x, ivf_pq.IvfPqIndexParams(n_lists=8, pq_dim=8))
+        before = int(idx.size)
+        _ = ivf_pq.extend(idx, x[:50])
+        # the ORIGINAL index still searches (buffers not deleted)
+        assert int(idx.size) == before
+        d, i = ivf_pq.search(idx, x[:8], 3, ivf_pq.IvfPqSearchParams(n_probes=8))
+        assert np.asarray(i).shape == (8, 3)
