@@ -21,7 +21,32 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["pack_lists", "chunked_queries", "scatter_append",
-           "scatter_append_copy", "shard_rows", "sharded_train_sizes"]
+           "scatter_append_copy", "shard_rows", "sharded_train_sizes",
+           "as_keep_mask", "sentinel_filtered_ids"]
+
+
+def as_keep_mask(filter, n=None):
+    """Normalize a prefilter (``core.Bitset`` or boolean array, True/1 =
+    keep) to a bool vector — the ``cuvs bitset_filter`` contract.  With
+    ``n`` the length is checked exactly (positional row numbering); IVF
+    callers instead validate against their max source id."""
+    if filter is None:
+        return None
+    from ..core.bitset import Bitset
+    from ..core.errors import expects
+
+    keep = filter.to_bool_array() if isinstance(filter, Bitset) else \
+        jnp.asarray(filter, bool)
+    expects(keep.ndim == 1, "filter must be 1-D")
+    if n is not None:
+        expects(keep.shape == (n,), f"filter covers {keep.shape}, need ({n},)")
+    return keep
+
+
+def sentinel_filtered_ids(vals, ids):
+    """Filtered-search output contract: slots that hold no real survivor
+    (±inf distance) report id −1, never a filtered row's id."""
+    return jnp.where(jnp.isfinite(vals), ids, -1)
 
 
 def shard_rows(dataset, mesh, axis: str):

@@ -207,24 +207,6 @@ def _fast_knn_impl(x, y, k: int, metric: str, cand: int, bm: int, bn: int,
     return vals, jnp.take_along_axis(short, p2, axis=1)
 
 
-def _as_keep_mask(filter, n=None):
-    """Normalize a prefilter (``core.Bitset`` or boolean array, True/1 =
-    keep) to a bool vector — the ``cuvs bitset_filter`` contract.  With
-    ``n`` the length is checked exactly (positional row numbering); IVF
-    callers pass ``n=None`` because their filter indexes *source ids*,
-    which may be sparse/custom."""
-    if filter is None:
-        return None
-    from ..core.bitset import Bitset
-
-    keep = filter.to_bool_array() if isinstance(filter, Bitset) else \
-        jnp.asarray(filter, bool)
-    expects(keep.ndim == 1, "filter must be 1-D")
-    if n is not None:
-        expects(keep.shape == (n,), f"filter covers {keep.shape}, need ({n},)")
-    return keep
-
-
 def knn(
     queries,
     database,
@@ -255,7 +237,9 @@ def knn(
     expects(k >= 1, "k must be >= 1")
     expects(k <= y.shape[0], f"k={k} exceeds database size {y.shape[0]}")
     expects(mode in ("exact", "fast"), f"unknown mode {mode!r}")
-    keep = _as_keep_mask(filter, y.shape[0])
+    from ._packing import as_keep_mask, sentinel_filtered_ids
+
+    keep = as_keep_mask(filter, y.shape[0])
     if mode == "fast":
         vals, ids = _fast_knn_impl(x, y, int(k), metric, int(max(cand, k)),
                                    1024, 1024, keep)
@@ -263,10 +247,7 @@ def knn(
         vals, ids = _knn_impl(x, y, int(k), metric,
                               int(min(tile, max(y.shape[0], 1))), keep)
     if keep is not None:
-        # contract: filtered rows never surface, even as inf-distance tail
-        # padding when fewer than k rows pass (±inf: IP similarities come
-        # back negated, so masked slots are -inf there)
-        ids = jnp.where(jnp.isfinite(vals), ids, -1)
+        ids = sentinel_filtered_ids(vals, ids)
     return vals, ids
 
 

@@ -299,25 +299,26 @@ def search(index: IvfFlatIndex, queries, k: int,
     ``filter``: optional prefilter by source id (``core.Bitset`` or bools
     over the ORIGINAL row numbering, True = keep) — cuVS bitset-filtered
     search parity."""
-    from .brute_force import _as_keep_mask
+    from ._packing import as_keep_mask, chunked_queries, sentinel_filtered_ids
 
     p = params or IvfFlatSearchParams()
     q = wrap_array(queries, ndim=2, name="queries")
     expects(q.shape[1] == index.dim, "query dim mismatch")
     n_probes = min(p.n_probes, index.n_lists)
-    keep = _as_keep_mask(filter)  # indexes source ids (may be custom)
+    keep = as_keep_mask(filter)  # indexes source ids (may be custom)
     if keep is not None:
-        # necessary bound even for custom ids: |ids| distinct ⇒ max ≥ size−1
-        expects(keep.shape[0] >= index.size,
-                f"filter covers {keep.shape[0]} ids, index holds {index.size}")
-    from ._packing import chunked_queries
+        # must cover the largest stored id: the gather clamps OOB indices,
+        # which would silently read an unrelated id's bit
+        expects(keep.shape[0] > int(jnp.max(index.ids)),
+                f"filter covers {keep.shape[0]} ids, index ids reach "
+                f"{int(jnp.max(index.ids))}")
 
     run = lambda qc: _search_impl(index.centroids, index.data, index.ids,
                                   index.counts, index.norms, qc, int(k),
                                   int(n_probes), index.metric, keep)
     dv, di = chunked_queries(run, q, int(p.query_chunk))
     if keep is not None:  # sub-k survivors: sentinel tail, not real ids
-        di = jnp.where(jnp.isfinite(dv), di, -1)
+        di = sentinel_filtered_ids(dv, di)
     return dv, di
 
 

@@ -280,7 +280,6 @@ def extend(index: IvfPqIndex, new_vectors, new_ids=None) -> IvfPqIndex:
     against the trained centroids/codebooks and scatter-append into the
     code slabs, growing list capacity when the new rows overflow it.  The
     derived recon tier is rebuilt when the source index carried one."""
-    from ..cluster.kmeans import capped_assign_room
     from ._packing import scatter_append_copy
 
     x = wrap_array(new_vectors, ndim=2)
@@ -292,9 +291,11 @@ def extend(index: IvfPqIndex, new_vectors, new_ids=None) -> IvfPqIndex:
                            dtype=jnp.int32))
 
     # grow capacity so every new row fits its nearest list (static shape:
-    # computed on host from a plain assignment histogram)
-    labels0 = jnp.argmin(sq_l2(x, index.centroids), axis=1)
-    added = jax.ops.segment_sum(jnp.ones_like(labels0, jnp.int32), labels0,
+    # computed on host from a plain assignment histogram); with capacity
+    # guaranteed, the capped assignment would degenerate to this argmin —
+    # so use it directly (same pattern as ivf_flat.extend)
+    labels = jnp.argmin(sq_l2(x, index.centroids), axis=1).astype(jnp.int32)
+    added = jax.ops.segment_sum(jnp.ones_like(labels, jnp.int32), labels,
                                 num_segments=L)
     new_cap = max(cap, int(jnp.max(index.counts + added)))
     pad = new_cap - cap
@@ -303,8 +304,6 @@ def extend(index: IvfPqIndex, new_vectors, new_ids=None) -> IvfPqIndex:
     slab_ids = (jnp.pad(index.ids, ((0, 0), (0, pad)), constant_values=-1)
                 if pad else index.ids)
 
-    labels, _ = capped_assign_room(x, index.centroids,
-                                   new_cap - index.counts)
     residuals = x - index.centroids[jnp.clip(labels, 0, L - 1)]
     ch_codes, ch_norms = _encode(residuals, index.codebooks, m)
     # non-donating form: the inputs may alias the LIVE source index's
@@ -497,18 +496,20 @@ def search(index: IvfPqIndex, queries, k: int,
 
     ``filter``: optional prefilter by source id (``core.Bitset`` or bools,
     True = keep) — cuVS bitset-filtered search parity."""
-    from .brute_force import _as_keep_mask
+    from ._packing import as_keep_mask, sentinel_filtered_ids
 
     p = params or IvfPqSearchParams()
     q = wrap_array(queries, ndim=2, name="queries")
     expects(q.shape[1] == index.dim, "query dim mismatch")
     expects(p.mode in ("auto", "recon", "lut"), f"unknown mode {p.mode!r}")
     n_probes = min(p.n_probes, index.n_lists)
-    keep = _as_keep_mask(filter)  # indexes source ids (may be custom)
+    keep = as_keep_mask(filter)  # indexes source ids (may be custom)
     if keep is not None:
-        # necessary bound even for custom ids: |ids| distinct ⇒ max ≥ size−1
-        expects(keep.shape[0] >= index.size,
-                f"filter covers {keep.shape[0]} ids, index holds {index.size}")
+        # must cover the largest stored id: the gather clamps OOB indices,
+        # which would silently read an unrelated id's bit
+        expects(keep.shape[0] > int(jnp.max(index.ids)),
+                f"filter covers {keep.shape[0]} ids, index ids reach "
+                f"{int(jnp.max(index.ids))}")
     mode = p.mode
     if mode == "auto":
         mode = "recon" if index.recon is not None else "lut"
@@ -526,7 +527,7 @@ def search(index: IvfPqIndex, queries, k: int,
             keep)
     dv, di = chunked_queries(run, q, int(p.query_chunk))
     if keep is not None:  # sub-k survivors: sentinel tail, not real ids
-        di = jnp.where(jnp.isfinite(dv), di, -1)
+        di = sentinel_filtered_ids(dv, di)
     return dv, di
 
 

@@ -152,3 +152,35 @@ class TestExtendPreservesSource:
         assert int(idx.size) == before
         d, i = ivf_pq.search(idx, x[:8], 3, ivf_pq.IvfPqSearchParams(n_probes=8))
         assert np.asarray(i).shape == (8, 3)
+
+
+class TestCagraExtend:
+    def test_extend_finds_new_nodes(self):
+        from raft_tpu.neighbors import cagra
+        rng = np.random.default_rng(9)
+        x1 = rng.standard_normal((2000, 16)).astype(np.float32)
+        x2 = rng.standard_normal((300, 16)).astype(np.float32)
+        idx = cagra.build(x1, cagra.CagraIndexParams(
+            intermediate_graph_degree=32, graph_degree=16, n_routers=32))
+        ext = cagra.extend(idx, x2)
+        assert ext.size == 2300 and ext.graph.shape == (2300, 16)
+        # querying the new vectors finds them (or a very near old row)
+        d, ids = cagra.search(ext, x2[:64], 1,
+                              cagra.CagraSearchParams(itopk_size=64))
+        hits = (np.asarray(ids)[:, 0] >= 2000).mean()
+        assert hits > 0.8
+        # old content still searchable
+        d, ids = cagra.search(ext, x1[:64], 1,
+                              cagra.CagraSearchParams(itopk_size=64))
+        assert (np.asarray(ids)[:, 0] == np.arange(64)).mean() > 0.9
+
+    def test_extend_preserves_source(self):
+        from raft_tpu.neighbors import cagra
+        rng = np.random.default_rng(10)
+        x = rng.standard_normal((500, 16)).astype(np.float32)
+        idx = cagra.build(x, cagra.CagraIndexParams(
+            intermediate_graph_degree=16, graph_degree=8, n_routers=16))
+        _ = cagra.extend(idx, x[:50])
+        assert idx.size == 500  # source untouched
+        d, i = cagra.search(idx, x[:8], 3, cagra.CagraSearchParams(itopk_size=16))
+        assert np.asarray(i).shape == (8, 3)
