@@ -22,7 +22,41 @@ import jax.numpy as jnp
 
 __all__ = ["pack_lists", "chunked_queries", "scatter_append",
            "scatter_append_copy", "shard_rows", "sharded_train_sizes",
-           "as_keep_mask", "sentinel_filtered_ids"]
+           "as_keep_mask", "sentinel_filtered_ids", "prefetch_chunks"]
+
+
+def prefetch_chunks(dataset, chunk_rows: int, ids=None):
+    """Yield ``(lo, hi, chunk_array, id_array)`` with the NEXT chunk's host
+    read running on a background worker while the caller's device work
+    consumes the current one — double-buffered host→device feeding for the
+    out-of-core builds (the native IO layer's ``pread`` releases the GIL,
+    so the overlap is real for memmap/np sources).
+
+    Same one-worker future pattern as ``io.BatchLoader.__iter__``: read
+    exceptions re-raise at the consumer (``future.result()``) and the
+    executor context joins the in-flight read even when the consumer's
+    loop body raises or breaks out early.
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    import numpy as np
+
+    n = dataset.shape[0]
+    bounds = [(lo, min(n, lo + chunk_rows)) for lo in range(0, n, chunk_rows)]
+
+    def read(lo, hi):
+        xc = np.asarray(dataset[lo:hi])
+        idc = (np.asarray(ids[lo:hi]) if ids is not None
+               else np.arange(lo, hi, dtype=np.int32))
+        return xc, idc
+
+    with ThreadPoolExecutor(max_workers=1) as pool:
+        future = None
+        for i, (lo, hi) in enumerate(bounds):
+            cur = read(lo, hi) if future is None else future.result()
+            future = (pool.submit(read, *bounds[i + 1])
+                      if i + 1 < len(bounds) else None)
+            yield lo, hi, cur[0], cur[1]
 
 
 def as_keep_mask(filter, n=None):

@@ -159,7 +159,7 @@ def build_chunked(dataset, params: Optional[IvfFlatIndexParams] = None, *,
     (``core/device_resources_snmg.hpp:36``) without a CUDA ancestor for the
     chunk loop itself (cuVS migration).
     """
-    from ._packing import scatter_append
+    from ._packing import prefetch_chunks, scatter_append
     from ..cluster.kmeans import capped_assign_room
 
     p = params or IvfFlatIndexParams()
@@ -175,16 +175,16 @@ def build_chunked(dataset, params: Optional[IvfFlatIndexParams] = None, *,
                       seed=p.seed)
     centroids, _, _ = kmeans_balanced_fit(np.asarray(dataset[sel]), kp)
 
-    # 2. stream chunks: capped assign against remaining room, scatter-append
+    # 2. stream chunks (next host read prefetched on a background thread
+    # while the device consumes the current one): capped assign against
+    # remaining room, donated scatter-append
     data = jnp.zeros((p.n_lists, cap, d), dtype)
     ids_slab = jnp.full((p.n_lists, cap), -1, jnp.int32)
     counts = jnp.zeros((p.n_lists,), jnp.int32)
-    for lo in range(0, n, chunk_rows):
-        hi = min(n, lo + chunk_rows)
-        xc = jnp.asarray(np.asarray(dataset[lo:hi]), dtype)
-        idc = (jnp.asarray(np.asarray(source_ids[lo:hi]), jnp.int32)
-               if source_ids is not None
-               else jnp.arange(lo, hi, dtype=jnp.int32))
+    for lo, hi, xc_h, idc_h in prefetch_chunks(dataset, chunk_rows,
+                                               source_ids):
+        xc = jnp.asarray(xc_h, dtype)
+        idc = jnp.asarray(idc_h, jnp.int32)
         labels, _ = capped_assign_room(xc, centroids, cap - counts)
         (data, ids_slab), counts = scatter_append(
             (data, ids_slab), counts, labels, (xc, idc),

@@ -335,7 +335,7 @@ def build_chunked(dataset, params: Optional[IvfPqIndexParams] = None, *,
     :func:`~._packing.scatter_append` of (codes, norms, ids).
     """
     from ..cluster.kmeans import capped_assign_room
-    from ._packing import scatter_append
+    from ._packing import prefetch_chunks, scatter_append
     from .ivf_flat import _train_subsample
 
     p = params or IvfPqIndexParams()
@@ -358,17 +358,16 @@ def build_chunked(dataset, params: Optional[IvfPqIndexParams] = None, *,
     codebooks = _train_codebooks(res_train, jax.random.fold_in(key, 7), m, c,
                                  p.pq_kmeans_n_iters)
 
-    # 2. stream chunks into the PQ slabs
+    # 2. stream chunks into the PQ slabs (next host read prefetched on a
+    # background thread while the device consumes the current one)
     codes = jnp.zeros((p.n_lists, cap, m), jnp.uint8)
     cnorms = jnp.zeros((p.n_lists, cap), jnp.float32)
     ids_slab = jnp.full((p.n_lists, cap), -1, jnp.int32)
     counts = jnp.zeros((p.n_lists,), jnp.int32)
-    for lo in range(0, n, chunk_rows):
-        hi = min(n, lo + chunk_rows)
-        xc = jnp.asarray(np.asarray(dataset[lo:hi]))
-        idc = (jnp.asarray(np.asarray(source_ids[lo:hi]), jnp.int32)
-               if source_ids is not None
-               else jnp.arange(lo, hi, dtype=jnp.int32))
+    for lo, hi, xc_h, idc_h in prefetch_chunks(dataset, chunk_rows,
+                                               source_ids):
+        xc = jnp.asarray(xc_h)
+        idc = jnp.asarray(idc_h, jnp.int32)
         labels, _ = capped_assign_room(xc, centroids, cap - counts)
         residuals = xc - centroids[jnp.clip(labels, 0, p.n_lists - 1)]
         ch_codes, ch_norms = _encode(residuals, codebooks, m)

@@ -21,7 +21,8 @@ run_step() {  # name, command...
     touch "$LOG/$name.done"
     echo "$(date) done $name" >> "$LOG/driver.log"
   else
-    echo "$(date) FAILED $name (rc=$?)" >> "$LOG/driver.log"
+    rc=$?
+    echo "$(date) FAILED $name (rc=$rc)" >> "$LOG/driver.log"
   fi
 }
 

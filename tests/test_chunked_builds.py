@@ -242,3 +242,22 @@ class TestDataParallelSearch:
         _, i2 = cagra.search_sharded(idx, q, 10, sp, mesh=mesh2x4,
                                      data_axis="data")
         np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
+class TestPrefetchChunks:
+    def test_yields_all_rows_in_order(self, rng):
+        from raft_tpu.neighbors._packing import prefetch_chunks
+        x = rng.standard_normal((1000, 4)).astype(np.float32)
+        seen = []
+        for lo, hi, xc, idc in prefetch_chunks(x, 256):
+            np.testing.assert_array_equal(xc, x[lo:hi])
+            np.testing.assert_array_equal(idc, np.arange(lo, hi))
+            seen.append((lo, hi))
+        assert seen == [(0, 256), (256, 512), (512, 768), (768, 1000)]
+
+    def test_custom_ids_pass_through(self, rng):
+        from raft_tpu.neighbors._packing import prefetch_chunks
+        x = rng.standard_normal((100, 4)).astype(np.float32)
+        ids = np.arange(1000, 1100, dtype=np.int32)
+        got = [idc for *_, idc in prefetch_chunks(x, 64, ids)]
+        np.testing.assert_array_equal(np.concatenate(got), ids)
