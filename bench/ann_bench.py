@@ -103,6 +103,9 @@ def main() -> None:
     ap.add_argument("--recall-floor", type=float, default=0.95)
     ap.add_argument("--chunked", action="store_true",
                     help="stream the build from host (out-of-core)")
+    ap.add_argument("--sharded", type=int, default=0, metavar="S",
+                    help="distributed build+search over an S-device mesh "
+                         "(ivf_flat/ivf_pq/cagra)")
     args = ap.parse_args()
 
     base = load_matrix(args.base, "base")
@@ -125,6 +128,28 @@ def main() -> None:
     from ann import default_n_lists
 
     n_lists = args.n_lists or default_n_lists(n)
+    mesh = None
+    if args.sharded:
+        if args.index == "brute_force":
+            raise SystemExit("--sharded: use ivf_flat/ivf_pq/cagra (the "
+                             "brute_force path here is single-device; "
+                             "knn_sharded is the library API)")
+        if args.chunked:
+            raise SystemExit("--chunked and --sharded are exclusive: the "
+                             "sharded build lays rows out per device, not "
+                             "streamed from host")
+        if args.index == "ivf_pq" and args.refine:
+            print(json.dumps({"note": "--refine ignored with --sharded "
+                              "(sharded sweep reports raw PQ recall)"}),
+                  flush=True)
+        import jax
+
+        devs = jax.devices()[: args.sharded]
+        if len(devs) < args.sharded:
+            raise SystemExit(f"--sharded {args.sharded}: only {len(devs)} "
+                             f"devices (for CPU simulation set XLA_FLAGS="
+                             f"--xla_force_host_platform_device_count=S)")
+        mesh = jax.sharding.Mesh(np.asarray(devs), ("shard",))
     t0 = time.time()
     if args.index == "brute_force":
         from raft_tpu.neighbors import brute_force
@@ -141,12 +166,24 @@ def main() -> None:
                                      metric=args.metric)
         else:
             p = mod.IvfFlatIndexParams(n_lists=n_lists, metric=args.metric)
-        build = mod.build_chunked if args.chunked else mod.build
-        src = np.asarray(base) if args.chunked else base
-        index = build(src, p)
+        if mesh is not None:
+            index = mod.build_sharded(base, mesh, p)
+        else:
+            build = mod.build_chunked if args.chunked else mod.build
+            src = np.asarray(base) if args.chunked else base
+            index = build(src, p)
         probes = ([int(v) for v in args.sweep.split(",")] if args.sweep
                   else [8, 16, 32, 64])
-        if args.index == "ivf_pq":
+        if mesh is not None:
+            sp_cls = (mod.IvfPqSearchParams if args.index == "ivf_pq"
+                      else mod.IvfFlatSearchParams)
+            curve = []
+            for np_ in probes:
+                run = (lambda sp=sp_cls(n_probes=np_):
+                       mod.search_sharded(index, q, args.k, sp, mesh=mesh))
+                curve.append({"n_probes": np_,
+                              **measure_point(run, gt, q.shape[0])})
+        elif args.index == "ivf_pq":
             curve = sweep_ivf_pq(index, q, gt, args.k, probes,
                                  refine_dataset=base if args.refine else None,
                                  refine_ratio=max(args.refine, 1))
@@ -160,10 +197,21 @@ def main() -> None:
             graph_degree=args.graph_degree, metric=args.metric,
             build_algo="ivf" if n > 200_000 else "brute_force",
             n_routers=max(128, min(1024, n // 2000)))
-        index = cagra.build(base, p)
         grid = ([tuple(int(v) for v in pt.split(":")) for pt in args.sweep.split(",")]
                 if args.sweep else [(32, 4), (64, 4), (64, 8)])
-        curve = sweep_cagra(index, q, gt, args.k, grid)
+        if mesh is not None:
+            index = cagra.build_sharded(base, mesh, p)
+            curve = []
+            for itopk, width in grid:
+                sp = cagra.CagraSearchParams(itopk_size=itopk,
+                                             search_width=width)
+                run = lambda sp=sp: cagra.search_sharded(
+                    index, q, args.k, sp, mesh=mesh)
+                curve.append({"itopk": itopk, "width": width,
+                              **measure_point(run, gt, q.shape[0])})
+        else:
+            index = cagra.build(base, p)
+            curve = sweep_cagra(index, q, gt, args.k, grid)
     build_s = round(time.time() - t0, 1)
 
     for pt in curve:
