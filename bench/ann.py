@@ -139,35 +139,41 @@ def measure_point(run, gt, nq: int) -> dict:
             "qps": round(measure_qps(run, nq), 1)}
 
 
-def sweep_ivf_flat(index, queries, gt, k: int, probe_grid) -> List[dict]:
-    """(n_probes → recall, qps) curve for IVF-Flat."""
+def sweep_ivf_flat(index, queries, gt, k: int, probe_grid, *,
+                   search_fn=None) -> List[dict]:
+    """(n_probes → recall, qps) curve for IVF-Flat.  ``search_fn`` swaps
+    the search implementation (e.g. ``partial(search_sharded, mesh=m)``)
+    while keeping the sweep protocol identical."""
     from raft_tpu.neighbors import ivf_flat
 
+    search_fn = search_fn or ivf_flat.search
     out = []
     nq = queries.shape[0]
     for n_probes in probe_grid:
         p = ivf_flat.IvfFlatSearchParams(n_probes=int(n_probes))
-        run = lambda p=p: ivf_flat.search(index, queries, k, p)
+        run = lambda p=p: search_fn(index, queries, k, p)
         out.append({"n_probes": int(n_probes), **measure_point(run, gt, nq)})
     return out
 
 
 def sweep_ivf_pq(index, queries, gt, k: int, probe_grid, *,
-                 refine_dataset=None, refine_ratio: int = 4
-                 ) -> List[dict]:
+                 refine_dataset=None, refine_ratio: int = 4,
+                 search_fn=None) -> List[dict]:
     """(n_probes → recall, qps) curve; with ``refine_dataset`` each search
     retrieves ``refine_ratio·k`` PQ candidates and exactly re-ranks them
-    (the standard IVF-PQ serving setup; ``neighbors.refine``)."""
+    (the standard IVF-PQ serving setup; ``neighbors.refine``).
+    ``search_fn`` swaps the search implementation (no-refine path only)."""
     from raft_tpu.neighbors import ivf_pq
     from raft_tpu.neighbors.refine import refine
 
+    search_fn = search_fn or ivf_pq.search
     out = []
     nq = queries.shape[0]
     for n_probes in probe_grid:
         p = ivf_pq.IvfPqSearchParams(n_probes=int(n_probes), query_chunk=0)
 
         if refine_dataset is None:
-            run = lambda p=p: ivf_pq.search(index, queries, k, p)
+            run = lambda p=p: search_fn(index, queries, k, p)
         else:
             def run(p=p):
                 _, cand = ivf_pq.search(index, queries, refine_ratio * k, p)
@@ -178,17 +184,20 @@ def sweep_ivf_pq(index, queries, gt, k: int, probe_grid, *,
     return out
 
 
-def sweep_cagra(index, queries, gt, k: int, grid, seed: int = 0
-                ) -> List[dict]:
-    """((itopk, search_width) → recall, qps) curve."""
+def sweep_cagra(index, queries, gt, k: int, grid, seed: int = 0, *,
+                search_fn=None) -> List[dict]:
+    """((itopk, search_width) → recall, qps) curve.  ``search_fn`` swaps
+    the search implementation (e.g. sharded)."""
     from raft_tpu.neighbors import cagra
 
+    search_fn = search_fn or (
+        lambda ix, q, kk, p: cagra.search(ix, q, kk, p, seed=seed))
     out = []
     nq = queries.shape[0]
     for itopk, width in grid:
         p = cagra.CagraSearchParams(itopk_size=int(itopk),
                                     search_width=int(width))
-        run = lambda p=p: cagra.search(index, queries, k, p, seed=seed)
+        run = lambda p=p: search_fn(index, queries, k, p)
         out.append({"itopk": int(itopk), "width": int(width),
                     **measure_point(run, gt, nq)})
     return out

@@ -150,10 +150,14 @@ def main() -> None:
                              f"devices (for CPU simulation set XLA_FLAGS="
                              f"--xla_force_host_platform_device_count=S)")
         mesh = jax.sharding.Mesh(np.asarray(devs), ("shard",))
+    from functools import partial
+
     t0 = time.time()
+    build_s = None
     if args.index == "brute_force":
         from raft_tpu.neighbors import brute_force
 
+        build_s = 0.0
         run = lambda: brute_force.knn(q, base, args.k, metric=args.metric,
                                       mode="fast")
         curve = [{"mode": "fast", **measure_point(run, gt, q.shape[0])}]
@@ -172,23 +176,19 @@ def main() -> None:
             build = mod.build_chunked if args.chunked else mod.build
             src = np.asarray(base) if args.chunked else base
             index = build(src, p)
+        build_s = round(time.time() - t0, 1)
         probes = ([int(v) for v in args.sweep.split(",")] if args.sweep
                   else [8, 16, 32, 64])
-        if mesh is not None:
-            sp_cls = (mod.IvfPqSearchParams if args.index == "ivf_pq"
-                      else mod.IvfFlatSearchParams)
-            curve = []
-            for np_ in probes:
-                run = (lambda sp=sp_cls(n_probes=np_):
-                       mod.search_sharded(index, q, args.k, sp, mesh=mesh))
-                curve.append({"n_probes": np_,
-                              **measure_point(run, gt, q.shape[0])})
-        elif args.index == "ivf_pq":
-            curve = sweep_ivf_pq(index, q, gt, args.k, probes,
-                                 refine_dataset=base if args.refine else None,
-                                 refine_ratio=max(args.refine, 1))
+        search_fn = (partial(mod.search_sharded, mesh=mesh)
+                     if mesh is not None else None)
+        if args.index == "ivf_pq":
+            curve = sweep_ivf_pq(
+                index, q, gt, args.k, probes,
+                refine_dataset=(base if args.refine and mesh is None else None),
+                refine_ratio=max(args.refine, 1), search_fn=search_fn)
         else:
-            curve = sweep_ivf_flat(index, q, gt, args.k, probes)
+            curve = sweep_ivf_flat(index, q, gt, args.k, probes,
+                                   search_fn=search_fn)
     else:  # cagra
         from raft_tpu.neighbors import cagra
 
@@ -201,18 +201,12 @@ def main() -> None:
                 if args.sweep else [(32, 4), (64, 4), (64, 8)])
         if mesh is not None:
             index = cagra.build_sharded(base, mesh, p)
-            curve = []
-            for itopk, width in grid:
-                sp = cagra.CagraSearchParams(itopk_size=itopk,
-                                             search_width=width)
-                run = lambda sp=sp: cagra.search_sharded(
-                    index, q, args.k, sp, mesh=mesh)
-                curve.append({"itopk": itopk, "width": width,
-                              **measure_point(run, gt, q.shape[0])})
+            search_fn = partial(cagra.search_sharded, mesh=mesh)
         else:
             index = cagra.build(base, p)
-            curve = sweep_cagra(index, q, gt, args.k, grid)
-    build_s = round(time.time() - t0, 1)
+            search_fn = None
+        build_s = round(time.time() - t0, 1)
+        curve = sweep_cagra(index, q, gt, args.k, grid, search_fn=search_fn)
 
     for pt in curve:
         print(json.dumps({"config": args.index, **pt}), flush=True)

@@ -481,6 +481,41 @@ class ShardedCagraIndex:
     n_rows: int = dataclasses.field(metadata=dict(static=True))
 
 
+@lru_cache(maxsize=32)
+def _sharded_search_program(mesh: Mesh, axis: str, data_axis: Optional[str],
+                            k: int, itopk: int, width: int, iters: int,
+                            n_seeds: int, metric: str, per: int,
+                            n_rows: int):
+    """Compile-once sharded search (jit keyed on the static config — a
+    per-call closure would re-trace every ``search_sharded`` call, which
+    dominates pipelined QPS measurements)."""
+
+    def local(ds, g, rc, rn, q_l, key):
+        bv, bi = _search_impl(ds[0], g[0], rc[0], rn[0], q_l, key, k,
+                              itopk, width, iters, n_seeds, metric)
+        shard = jax.lax.axis_index(axis)
+        bi = jnp.where(bi >= 0, bi + shard * per, bi)
+        if metric == "inner_product":
+            bv = -bv  # back to min-selectable before masking
+        bv = jnp.where((bi >= 0) & (bi < n_rows), bv, jnp.inf)
+        av = jax.lax.all_gather(bv, axis)
+        ai = jax.lax.all_gather(bi, axis)
+        av = jnp.moveaxis(av, 0, 1).reshape(q_l.shape[0], -1)
+        ai = jnp.moveaxis(ai, 0, 1).reshape(q_l.shape[0], -1)
+        fv, fi = select_k(av, k, in_idx=ai, select_min=True)
+        if metric == "inner_product":
+            fv = -fv
+        return fv, fi
+
+    qspec = P(data_axis) if data_axis else P()
+    return jax.jit(jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(axis), qspec, P()),
+        out_specs=(qspec, qspec),
+        check_vma=False,
+    ))
+
+
 def search_sharded(index: ShardedCagraIndex, queries, k: int,
                    params: Optional[CagraSearchParams] = None, *,
                    mesh: Mesh, axis: str = "shard",
@@ -499,36 +534,12 @@ def search_sharded(index: ShardedCagraIndex, queries, k: int,
     iters = p.max_iterations or max(1, (itopk + p.search_width - 1)
                                     // p.search_width)
     per = int(index.datasets.shape[1])
-    key = jax.random.PRNGKey(seed)
-    n_seeds = int(min(p.n_seeds, per))
-    metric = index.metric
-    kk, width = int(k), int(p.search_width)
-
-    def local(ds, g, rc, rn, q_l):
-        bv, bi = _search_impl(ds[0], g[0], rc[0], rn[0], q_l, key, kk,
-                              int(itopk), width, int(iters), n_seeds, metric)
-        shard = jax.lax.axis_index(axis)
-        bi = jnp.where(bi >= 0, bi + shard * per, bi)
-        if metric == "inner_product":
-            bv = -bv  # back to min-selectable before masking
-        bv = jnp.where((bi >= 0) & (bi < index.n_rows), bv, jnp.inf)
-        av = jax.lax.all_gather(bv, axis)
-        ai = jax.lax.all_gather(bi, axis)
-        av = jnp.moveaxis(av, 0, 1).reshape(q_l.shape[0], -1)
-        ai = jnp.moveaxis(ai, 0, 1).reshape(q_l.shape[0], -1)
-        fv, fi = select_k(av, kk, in_idx=ai, select_min=True)
-        if metric == "inner_product":
-            fv = -fv
-        return fv, fi
-
-    qspec = P(data_axis) if data_axis else P()
-    return jax.jit(jax.shard_map(
-        local, mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis), P(axis), qspec),
-        out_specs=(qspec, qspec),
-        check_vma=False,
-    ))(index.datasets, index.graphs, index.router_centroids,
-       index.router_nodes, q)
+    prog = _sharded_search_program(
+        mesh, axis, data_axis, int(k), int(itopk), int(p.search_width),
+        int(iters), int(min(p.n_seeds, per)), index.metric, per,
+        int(index.n_rows))
+    return prog(index.datasets, index.graphs, index.router_centroids,
+                index.router_nodes, q, jax.random.PRNGKey(seed))
 
 
 def search(index: CagraIndex, queries, k: int,
