@@ -1,0 +1,8 @@
+"""Compatibility layers for users migrating from the reference stack.
+
+``raft_tpu.compat.pylibraft`` mirrors the pylibraft package layout
+(``python/pylibraft/pylibraft``) so existing call sites keep working::
+
+    from raft_tpu.compat import pylibraft
+    from raft_tpu.compat.pylibraft.sparse.linalg import eigsh
+"""

@@ -1,0 +1,10 @@
+"""pylibraft-shaped API over raft_tpu — module paths, entry-point names,
+and call conventions of ``python/pylibraft/pylibraft`` (the north star's
+"expose everything through pylibraft unchanged"), backed by the TPU-native
+implementations.  CUDA-specific surfaces (streams, __cuda_array_interface__)
+have no TPU meaning and are represented by host/device-array equivalents.
+"""
+
+from . import common, distance, random, sparse  # noqa: F401
+
+__version__ = "26.08.00+tpu"

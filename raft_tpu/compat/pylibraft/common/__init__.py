@@ -1,0 +1,89 @@
+"""``pylibraft.common`` parity: handles and the owning device array.
+
+``Handle``/``DeviceResources`` (``common/handle.pyx:21,125``) map to the
+framework's :class:`raft_tpu.core.DeviceResources`; ``device_ndarray``
+(``common/device_ndarray.py:10``) wraps a committed ``jax.Array`` with the
+same factory/accessor surface minus ``__cuda_array_interface__``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from raft_tpu.core import DeviceResources
+
+__all__ = ["Handle", "DeviceResources", "device_ndarray", "fill_out"]
+
+# the core handle already carries sync(*arrays) (resources.py:150)
+Handle = DeviceResources  # deprecated alias, as upstream
+
+
+def fill_out(out, values):
+    """Honor an upstream out-parameter: fill ``out`` in place and return
+    it.  numpy arrays are written directly; :class:`device_ndarray`
+    rebinds its device buffer (np.asarray(out) would write a throwaway
+    host copy and silently lose the result)."""
+    if isinstance(out, np.ndarray):
+        out[...] = np.asarray(values).astype(out.dtype, copy=False)
+        return out
+    if isinstance(out, device_ndarray):
+        import jax.numpy as jnp
+
+        out._array = jnp.asarray(values, dtype=out.dtype)
+        return out
+    raise TypeError(
+        f"out must be np.ndarray or device_ndarray, got {type(out).__name__}")
+
+
+class device_ndarray:
+    """Owning device array (``common/device_ndarray.py:10`` parity).
+
+    Note: 64-bit dtypes follow JAX's dtype policy — without
+    ``jax_enable_x64`` they are stored as their 32-bit counterparts (TPUs
+    have no f64 units; the reference's CUDA arrays keep f64).
+
+    >>> import numpy as np
+    >>> a = device_ndarray(np.arange(6, dtype=np.float32).reshape(2, 3))
+    >>> a.shape, a.dtype.name, a.c_contiguous
+    ((2, 3), 'float32', True)
+    >>> bool((a.copy_to_host() == np.arange(6).reshape(2, 3)).all())
+    True
+    """
+
+    def __init__(self, np_ndarray):
+        import jax.numpy as jnp
+
+        self._array = jnp.asarray(np_ndarray)
+
+    @classmethod
+    def empty(cls, shape, dtype=np.float32, order="C"):
+        if order != "C":
+            # XLA storage is row-major; silently accepting 'F' would make
+            # the contiguity flags lie to layout-branching call sites
+            raise ValueError("device_ndarray only supports order='C' "
+                             "(XLA layout); use core.copy for F-order host "
+                             "views")
+        return cls(np.zeros(shape, dtype=dtype, order=order))
+
+    @property
+    def c_contiguous(self):
+        return True  # XLA arrays are logically row-major
+
+    @property
+    def f_contiguous(self):
+        return False
+
+    @property
+    def dtype(self):
+        return np.dtype(self._array.dtype)
+
+    @property
+    def shape(self):
+        return tuple(self._array.shape)
+
+    def copy_to_host(self):
+        return np.asarray(self._array)
+
+    def __array__(self, dtype=None):
+        h = self.copy_to_host()
+        return h.astype(dtype) if dtype is not None else h

@@ -1,0 +1,40 @@
+"""``pylibraft.distance`` parity (the pre-cuVS surface the reference's
+README now delegates — ``README.md:96-119``)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["pairwise_distance", "DISTANCE_TYPES"]
+
+
+def _distance_types():
+    # derived from the backing alias table so the advertised list can
+    # never drift from what _as_metric actually accepts
+    from raft_tpu.distance.pairwise import _ALIASES
+
+    return sorted(_ALIASES)
+
+
+DISTANCE_TYPES = _distance_types()
+
+
+def pairwise_distance(X, Y=None, out=None, metric="euclidean", p=2.0,
+                      handle=None):
+    """Upstream convention: optional preallocated ``out`` is filled and
+    returned; otherwise a new array comes back.
+
+    >>> import numpy as np
+    >>> x = np.random.default_rng(0).standard_normal((4, 3)).astype(np.float32)
+    >>> d = pairwise_distance(x, metric="euclidean")
+    >>> d.shape == (4, 4) and abs(float(np.asarray(d)[0, 0])) < 1e-5
+    True
+    """
+    from raft_tpu.distance.pairwise import pairwise_distance as _pd
+
+    from ..common import fill_out
+
+    dist = _pd(X, Y, metric, p=float(p))
+    if out is not None:
+        return fill_out(out, dist)
+    return dist

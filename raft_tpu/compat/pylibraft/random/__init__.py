@@ -1,0 +1,29 @@
+"""``pylibraft.random`` parity: the RMAT generator with the upstream
+out-parameter convention (``random/rmat_rectangular_generator.pyx:69``)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["rmat"]
+
+
+def rmat(out, theta, r_scale, c_scale, seed=12345, handle=None):
+    """Fill ``out`` (n_edges, 2) with RMAT edges; also returns it.
+
+    >>> import numpy as np
+    >>> out = np.zeros((100, 2), np.int64)
+    >>> _ = rmat(out, np.array([0.57, 0.19, 0.19, 0.05] * 4, np.float32), 4, 4)
+    >>> bool((out >= 0).all() and (out < 16).all())
+    True
+    """
+    from raft_tpu.random import RngState
+    from raft_tpu.random.rmat import rmat as _rmat
+
+    from ..common import fill_out
+
+    if len(out.shape) != 2 or out.shape[1] != 2:
+        raise ValueError("out must be (n_edges, 2)")
+    edges = _rmat(RngState(int(seed)), int(out.shape[0]), np.asarray(theta),
+                  int(r_scale), int(c_scale))
+    return fill_out(out, edges)

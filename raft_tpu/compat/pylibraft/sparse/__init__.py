@@ -1,0 +1,3 @@
+"""``pylibraft.sparse`` parity."""
+
+from . import linalg  # noqa: F401

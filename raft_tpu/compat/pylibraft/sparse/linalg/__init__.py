@@ -1,0 +1,42 @@
+"""``pylibraft.sparse.linalg`` parity: ``eigsh`` / ``svds`` with the
+upstream call conventions (``sparse/linalg/lanczos.pyx:100``,
+``sparse/linalg/svds.pyx:73``) — scipy.sparse / dense / raft CSR inputs
+all accepted."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["eigsh", "svds"]
+
+
+def _as_csr(a):
+    from raft_tpu.sparse.types import COO, CSR
+
+    if isinstance(a, (CSR, COO)):
+        return a
+    if hasattr(a, "tocsr"):  # scipy.sparse (any format)
+        sp = a.tocsr()
+        return CSR.from_arrays(sp.indptr, sp.indices, sp.data, sp.shape)
+    return CSR.from_dense(np.asarray(a))
+
+
+def eigsh(A, k=6, which="LM", v0=None, ncv=None, maxiter=None,
+          tol=0, seed=None, handle=None):
+    """Thick-restart Lanczos, upstream signature (``lanczos.pyx:100``).
+    Returns ``(eigenvalues, eigenvectors)``."""
+    from raft_tpu.sparse.solver.lanczos import eigsh as _eigsh
+
+    return _eigsh(
+        _as_csr(A), int(k), which=which, ncv=ncv,
+        maxiter=1000 if maxiter is None else int(maxiter),
+        tol=float(tol), v0=v0, seed=42 if seed is None else int(seed))
+
+
+def svds(a, k=6, *, p=10, n_iters=4, seed=None, handle=None):
+    """Randomized sparse SVD, upstream signature (``svds.pyx:73``).
+    Returns ``(U, S, V)``."""
+    from raft_tpu.sparse.solver.randomized_svd import svds as _svds
+
+    return _svds(_as_csr(a), int(k), p=int(p), n_iters=int(n_iters),
+                 seed=42 if seed is None else int(seed))

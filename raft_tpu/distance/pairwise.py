@@ -67,6 +67,7 @@ _ALIASES = {
     "l1": DistanceType.L1,
     "cityblock": DistanceType.L1,
     "manhattan": DistanceType.L1,
+    "taxicab": DistanceType.L1,
     "chebyshev": DistanceType.Linf,
     "linf": DistanceType.Linf,
     "canberra": DistanceType.Canberra,
