@@ -5,11 +5,15 @@ Splits the wall-clock QPS into its parts (VERDICT r2 weak #1):
 * **tunnel RTT**: single-dispatch latency minus pipelined per-call time
   (depth-8 pipelining keeps the device queue full, amortizing the remote
   link round trip),
-* **MXU floor**: a plain bf16 matmul of the same shape — the physically
+* **MXU floor**: a *tiled* bf16 matmul of the same shape with a min
+  epilogue per tile (the (m, n) product is never materialized — at
+  10k×1M f32 it would be 40 GB, over any chip's HBM) — the physically
   unbeatable time for the distance pass,
 * **fused_shortlist** alone across a (bm, bn) block-size grid,
-* **full fast path** (shortlist + top-k + exact f32 rescore) and the
-  exact path, for contrast.
+* the post-shortlist stages one at a time: the (m, 2·bn)→cand top-k
+  cut (exact ``lax.top_k`` vs ``approx_max_k``), the (m, cand) row
+  gather + exact f32 re-score,
+* **full fast path** and the exact path, for contrast.
 
 Usage: ``python bench/profile_knn.py [--m 10000 --n 1000000 --d 128]``.
 Prints one JSON line per measurement; effective TFLOP/s uses
@@ -18,6 +22,7 @@ Prints one JSON line per measurement; effective TFLOP/s uses
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import sys
@@ -48,10 +53,30 @@ def single(fn, reps: int = 3) -> float:
     return single_latency(fn, reps)
 
 
+@functools.partial(jax.jit, static_argnames=("tile",))
+def _tiled_min_matmul(x, y, tile: int = 65536):
+    """min_j(x·yᵀ) without materializing (m, n): scan over column tiles."""
+    n, d = y.shape
+    pad = (-n) % tile
+    if pad:
+        y = jnp.concatenate([y, jnp.zeros((pad, d), y.dtype)], axis=0)
+    ytiles = y.reshape(-1, tile, d)
+
+    def step(best, yt):
+        dots = jnp.dot(x, yt.T, preferred_element_type=jnp.float32)
+        return jnp.minimum(best, jnp.min(dots, axis=1)), None
+
+    init = jnp.full((x.shape[0],), jnp.inf, jnp.float32)
+    best, _ = jax.lax.scan(step, init, ytiles)
+    return best
+
+
 def main() -> None:
     m = _arg("--m", 10_000)
     n = _arg("--n", 1_000_000)
     d = _arg("--d", 128)
+    k = 10
+    cand = 64
     flops = 2.0 * m * n * d
 
     key = jax.random.PRNGKey(0)
@@ -68,31 +93,52 @@ def main() -> None:
             "tflops": round(flops / t / 1e12, 1),
             **(extra or {})}), flush=True)
 
-    # MXU floor: the distance matmul with a tiny reduction epilogue so the
-    # (m, n) product never transfers (sum ~ one f32 per row)
-    mm = jax.jit(lambda a, b: jnp.min(
-        jnp.dot(a, b.T, preferred_element_type=jnp.float32), axis=1))
-    t = pipelined(lambda: mm(qb, dbb))
-    emit("matmul_floor_bf16", t)
+    def guarded(case, fn, **kw):
+        try:
+            t = pipelined(fn)
+        except Exception as e:  # noqa: BLE001 — one OOM must not kill the study
+            print(json.dumps({"case": case, "error": str(e)[:160]}), flush=True)
+            return
+        emit(case, t, kw or None)
+
+    guarded("matmul_floor_bf16_tiled", lambda: _tiled_min_matmul(qb, dbb))
 
     # fused_shortlist block-size sweep
     from raft_tpu.ops.pallas.fused_l2_topk import fused_shortlist
 
     for bm in (256, 512, 1024):
         for bn in (1024, 2048):
-            try:
-                t = pipelined(lambda bm=bm, bn=bn: fused_shortlist(
-                    qb, dbb, yn, bm=bm, bn=bn))
-            except Exception as e:  # noqa: BLE001
-                print(json.dumps({"case": f"shortlist_bm{bm}_bn{bn}",
-                                  "error": str(e)[:120]}), flush=True)
-                continue
-            emit(f"shortlist_bm{bm}_bn{bn}", t)
+            guarded(f"shortlist_bm{bm}_bn{bn}",
+                    lambda bm=bm, bn=bn: fused_shortlist(qb, dbb, yn, bm=bm, bn=bn))
+
+    # post-shortlist stages, isolated on a held shortlist output
+    sv, si = fetch(fused_shortlist(qb, dbb, yn, bm=1024, bn=1024))
+    sv = jax.block_until_ready(sv)
+    si = jax.block_until_ready(si)
+
+    cut_exact = jax.jit(lambda v: jax.lax.top_k(-v, cand))
+    guarded("cut_topk_exact_2048to64", lambda: cut_exact(sv))
+    cut_approx = jax.jit(lambda v: jax.lax.approx_max_k(
+        -v, cand, recall_target=0.99))
+    guarded("cut_topk_approx_2048to64", lambda: cut_approx(sv))
+
+    neg, pos = fetch(cut_exact(sv))
+    short = jax.block_until_ready(jnp.take_along_axis(si, pos, axis=1))
+
+    @jax.jit
+    def rescore(short):
+        from raft_tpu.neighbors.brute_force import _exact_candidate_distances
+
+        dc = _exact_candidate_distances(q, db[short], "sqeuclidean")
+        negv, p2 = jax.lax.top_k(-dc, k)
+        return -negv, jnp.take_along_axis(short, p2, axis=1)
+
+    guarded("refine_gather_rescore_64", lambda: rescore(short))
 
     # full fast path (current defaults) + RTT split
     from raft_tpu.neighbors.brute_force import _fast_knn_impl, _knn_impl
 
-    fast = lambda: _fast_knn_impl(q, db, 10, "sqeuclidean", 64, 1024, 1024)
+    fast = lambda: _fast_knn_impl(q, db, k, "sqeuclidean", cand, 1024, 1024)
     t1 = single(fast)
     tp = pipelined(fast)
     emit("fast_full", tp, {
@@ -100,8 +146,7 @@ def main() -> None:
         "tunnel_overhead_ms": round((t1 - tp) * 1e3, 2),
         "qps_pipelined": round(m / tp, 0)})
 
-    t = pipelined(lambda: _knn_impl(q, db, 10, "sqeuclidean", 65536), depth=2)
-    emit("exact_full", t)
+    guarded("exact_full", lambda: _knn_impl(q, db, k, "sqeuclidean", 65536))
 
 
 if __name__ == "__main__":
