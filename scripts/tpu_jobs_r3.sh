@@ -7,7 +7,9 @@ cd /root/repo
 LOG=/tmp/tpu_jobs_r3
 mkdir -p "$LOG"
 
-probe() { timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; }
+# a real computation, not just jax.devices(): backend init can succeed
+# while the compute leg of the tunnel is wedged
+probe() { timeout 120 python -c "import jax, jax.numpy as jnp; (jnp.ones((8,8)) @ jnp.ones((8,8))).sum().item()" >/dev/null 2>&1; }
 
 echo "$(date) waiting for TPU..." >> "$LOG/driver.log"
 until probe; do sleep 120; done
