@@ -21,11 +21,16 @@ Configs:
    the ``neighborhood_recall.cuh:77`` role).
 3. **cagra @ 1M**: IVF-sourced optimized graph, (itopk × width) sweep,
    best QPS at recall ≥ 0.95.
+4. **pairwise @ 10k×128** (ladder config #1): L2 + cosine full distance
+   matrix, reported as effective TFLOP/s.
+5. **ivf_flat + kmeans_balanced @ SIFT-1M-class** (ladder config #3):
+   ``kmeans_balanced_fit`` throughput (rows/s) at the IVF coarse-quantizer
+   shape, then an IVF-Flat n_probes sweep → best QPS at recall ≥ 0.95.
 
 Scale knobs (smoke-testing): RAFT_BENCH_PQ_ROWS, RAFT_BENCH_CAGRA_ROWS,
-RAFT_BENCH_SKIP (comma list of {ivf_pq,cagra}).  Each config is
-independently fault-isolated so a failure cannot take down the headline
-line.
+RAFT_BENCH_IF_ROWS, RAFT_BENCH_SKIP (comma list of
+{ivf_pq,cagra,pairwise,ivf_flat}).  Each config is independently
+fault-isolated so a failure cannot take down the headline line.
 
 The reference repo publishes no numbers ("published": {}); ``vs_baseline``
 reports against the recorded best of PREVIOUS rounds (BENCH_HISTORY.json),
@@ -63,6 +68,7 @@ HISTORY = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_HISTOR
 
 PQ_ROWS = int(os.environ.get("RAFT_BENCH_PQ_ROWS", 10_000_000))
 CAGRA_ROWS = int(os.environ.get("RAFT_BENCH_CAGRA_ROWS", 1_000_000))
+IF_ROWS = int(os.environ.get("RAFT_BENCH_IF_ROWS", 1_000_000))
 SKIP = set(filter(None, os.environ.get("RAFT_BENCH_SKIP", "").split(",")))
 
 
@@ -176,6 +182,75 @@ def _bench_cagra(rows=None):
             "best": best}
 
 
+def _bench_pairwise(rows=None):
+    """Ladder config #1: pairwise_distance (L2 + cosine) on 10k×128."""
+    import jax
+    import jax.numpy as jnp
+
+    from ann import measure_qps
+    from raft_tpu.distance import pairwise_distance
+
+    n, d = rows or 10_000, 128
+    key = jax.random.PRNGKey(5)
+    x = jax.block_until_ready(jax.random.normal(key, (n, d), jnp.float32))
+    out = {"rows": n, "dim": d}
+    flops = 2.0 * n * n * d
+    for metric in ("sqeuclidean", "cosine"):
+        # reduce to a scalar on device: fetching the (n, n) matrix per rep
+        # (~400 MB over the tunnel) would time transfer, not compute
+        run = lambda metric=metric: jnp.sum(
+            pairwise_distance(x, x, metric=metric))
+        per_call = 1.0 / measure_qps(run, 1, reps=4)
+        out[metric] = {"ms": round(per_call * 1e3, 2),
+                       "tflops": round(flops / per_call / 1e12, 2)}
+    out["tflops"] = out["sqeuclidean"]["tflops"]
+    return out
+
+
+def _bench_ivf_flat_kmeans(rows=None):
+    """Ladder config #3: kmeans_balanced fit throughput + IVF-Flat
+    QPS@recall-0.95 on a SIFT-1M-class corpus."""
+    import time as _time
+
+    import numpy as np
+
+    from ann import best_at_recall, ground_truth, make_clustered, sweep_ivf_flat
+    from raft_tpu.cluster.kmeans import KMeansParams, kmeans_balanced_fit
+    from raft_tpu.neighbors import ivf_flat
+
+    n, d, nq = rows or IF_ROWS, 128, 10_000
+    n_clusters = max(64, n // 1000)
+    n_lists = min(1024, max(64, n // 1000))
+    db = make_clustered(n, d, n_clusters, seed=17, scale=2.0)
+    q = make_clustered(nq, d, n_clusters, seed=17, scale=2.0, point_seed=1)
+    gt = ground_truth(q, db, K)
+
+    # kmeans_balanced fit throughput at the coarse-quantizer shape.  The
+    # warm-up must run the FULL shape: the fit program is jit-specialized
+    # on (n, k, max_iter, cap), so a small-slice warm-up would leave the
+    # timed fit paying compilation
+    kp = KMeansParams(n_clusters=n_lists, max_iter=10, seed=0)
+    np.asarray(kmeans_balanced_fit(db, kp)[0])
+    t0 = _time.time()
+    centroids, _, _ = kmeans_balanced_fit(db, kp)
+    np.asarray(centroids)  # completion barrier (see ann.fetch)
+    fit_s = _time.time() - t0
+    kmeans_rows_s = n * kp.max_iter / fit_s
+
+    t0 = _time.time()
+    index = ivf_flat.build(db, ivf_flat.IvfFlatIndexParams(n_lists=n_lists,
+                                                           seed=0))
+    build_s = _time.time() - t0
+    curve = sweep_ivf_flat(index, q, gt, K, [1, 2, 4, 8, 16])
+    best = best_at_recall(curve, RECALL_FLOOR)
+    return {"rows": n, "dim": d, "n_lists": n_lists,
+            "kmeans_fit_s": round(fit_s, 1),
+            "kmeans_rows_per_s": round(kmeans_rows_s, 0),
+            "build_s": round(build_s, 1), "curve": curve,
+            "qps_at_recall95": None if best is None else best["qps"],
+            "best": best}
+
+
 def main() -> None:
     north_star = {}
 
@@ -187,10 +262,12 @@ def main() -> None:
         traceback.print_exc()
         qps, recall, profile = 0.0, 0.0, {"error": f"{type(e).__name__}: {e}"}
 
-    for name, fn, full_rows in (
-            ("ivf_pq_deep10m_class", _bench_ivf_pq, PQ_ROWS),
-            ("cagra_1m", _bench_cagra, CAGRA_ROWS)):
-        short = name.split("_")[0] if name.startswith("cagra") else "ivf_pq"
+    for name, fn, full_rows, floor, short in (
+            ("ivf_pq_deep10m_class", _bench_ivf_pq, PQ_ROWS, 100_000, "ivf_pq"),
+            ("cagra_1m", _bench_cagra, CAGRA_ROWS, 100_000, "cagra"),
+            ("pairwise_10kx128", _bench_pairwise, 10_000, 1_000, "pairwise"),
+            ("ivf_flat_kmeans_1m", _bench_ivf_flat_kmeans, IF_ROWS, 100_000,
+             "ivf_flat")):
         if short in SKIP:
             continue
         try:
@@ -200,9 +277,11 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — keep the headline alive
             traceback.print_exc()
             # a quarter-scale number still anchors the curve; an OOM at
-            # full scale must not zero out the whole config
+            # full scale must not zero out the whole config.  The floor is
+            # per-config: clamping every retry up to 100k would scale the
+            # 10k pairwise config UP on failure
             try:
-                res = fn(rows=max(100_000, full_rows // 4))
+                res = fn(rows=min(full_rows, max(floor, full_rows // 4)))
                 res["reduced_scale"] = True
                 north_star[name] = res
                 print(json.dumps({"config": name, **res}))
@@ -222,12 +301,16 @@ def main() -> None:
     vs = (qps / prev) if prev else 1.0
     if prev is None or qps > prev:  # record recall only with the run it belongs to
         hist.update({"knn_qps": qps, "recall": recall, "protocol": PROTOCOL})
-    for name, key in (("ivf_pq_deep10m_class", "ivf_pq_qps95"),
-                      ("cagra_1m", "cagra_qps95")):
+    for name, field, key in (
+            ("ivf_pq_deep10m_class", "qps_at_recall95", "ivf_pq_qps95"),
+            ("cagra_1m", "qps_at_recall95", "cagra_qps95"),
+            ("ivf_flat_kmeans_1m", "qps_at_recall95", "ivf_flat_qps95"),
+            ("pairwise_10kx128", "tflops", "pairwise_tflops"),
+            ("ivf_flat_kmeans_1m", "kmeans_rows_per_s", "kmeans_rows_s")):
         res = north_star.get(name) or {}
-        val = res.get("qps_at_recall95")
+        val = res.get(field)
         # reduced-scale retries report but never ratchet (smaller corpus =
-        # inflated QPS; the key tracks the full-scale config only)
+        # inflated numbers; each key tracks the full-scale config only)
         if val is not None and not res.get("reduced_scale") \
                 and val > hist.get(key, 0):
             hist[key] = val
