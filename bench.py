@@ -210,8 +210,6 @@ def _bench_pairwise(rows=None):
 def _bench_ivf_flat_kmeans(rows=None):
     """Ladder config #3: kmeans_balanced fit throughput + IVF-Flat
     QPS@recall-0.95 on a SIFT-1M-class corpus."""
-    import time as _time
-
     import numpy as np
 
     from ann import best_at_recall, ground_truth, make_clustered, sweep_ivf_flat
@@ -231,16 +229,16 @@ def _bench_ivf_flat_kmeans(rows=None):
     # timed fit paying compilation
     kp = KMeansParams(n_clusters=n_lists, max_iter=10, seed=0)
     np.asarray(kmeans_balanced_fit(db, kp)[0])
-    t0 = _time.time()
+    t0 = time.time()
     centroids, _, _ = kmeans_balanced_fit(db, kp)
     np.asarray(centroids)  # completion barrier (see ann.fetch)
-    fit_s = _time.time() - t0
+    fit_s = time.time() - t0
     kmeans_rows_s = n * kp.max_iter / fit_s
 
-    t0 = _time.time()
+    t0 = time.time()
     index = ivf_flat.build(db, ivf_flat.IvfFlatIndexParams(n_lists=n_lists,
                                                            seed=0))
-    build_s = _time.time() - t0
+    build_s = time.time() - t0
     curve = sweep_ivf_flat(index, q, gt, K, [1, 2, 4, 8, 16])
     best = best_at_recall(curve, RECALL_FLOOR)
     return {"rows": n, "dim": d, "n_lists": n_lists,
@@ -280,8 +278,12 @@ def main() -> None:
             # full scale must not zero out the whole config.  The floor is
             # per-config: clamping every retry up to 100k would scale the
             # 10k pairwise config UP on failure
+            retry_rows = min(full_rows, max(floor, full_rows // 4))
+            if retry_rows == full_rows:  # nothing smaller to try
+                north_star[name] = {"error": f"{type(e).__name__}: {e}"}
+                continue
             try:
-                res = fn(rows=min(full_rows, max(floor, full_rows // 4)))
+                res = fn(rows=retry_rows)
                 res["reduced_scale"] = True
                 north_star[name] = res
                 print(json.dumps({"config": name, **res}))
@@ -318,7 +320,9 @@ def main() -> None:
     # smoke runs at reduced RAFT_BENCH_* scales must not pollute history
     import jax
 
-    record = jax.default_backend() == "tpu" and "RAFT_BENCH_BF_ROWS" not in os.environ
+    record = jax.default_backend() == "tpu" and not any(
+        k in os.environ for k in ("RAFT_BENCH_BF_ROWS", "RAFT_BENCH_PQ_ROWS",
+                                  "RAFT_BENCH_CAGRA_ROWS", "RAFT_BENCH_IF_ROWS"))
     if record:
         try:
             with open(HISTORY, "w") as f:

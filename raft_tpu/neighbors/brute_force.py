@@ -135,9 +135,9 @@ def _exact_candidate_distances(x, yc, metric: str):
     return _metric_from_dots(dots, xn, yn, metric)
 
 
-@partial(jax.jit, static_argnames=("k", "metric", "cand", "bm", "bn"))
+@partial(jax.jit, static_argnames=("k", "metric", "cand", "bm", "bn", "cut"))
 def _fast_knn_impl(x, y, k: int, metric: str, cand: int, bm: int, bn: int,
-                   keep=None):
+                   keep=None, cut: str = "exact"):
     """bf16 shortlist (fused Pallas kernel on TPU, XLA approx_max_k
     elsewhere) + exact f32 refine.  Smaller-is-nearer surrogate:
     ``‖y‖² − 2·x·yᵀ`` for L2/cosine-normalized data, ``−x·yᵀ`` for
@@ -193,7 +193,14 @@ def _fast_knn_impl(x, y, k: int, metric: str, cand: int, bm: int, bn: int,
         sv = jnp.moveaxis(cv, 0, 1).reshape(m, -1)
         si = jnp.moveaxis(ci, 0, 1).reshape(m, -1)
     cand = min(cand, sv.shape[1])
-    neg, pos = jax.lax.top_k(-sv, cand)
+    if cut == "approx":
+        # approx_max_k is the TPU-optimized partial reduction (the op the
+        # TPU-KNN paper introduced); misses are recovered nowhere, so it
+        # trades a sliver of recall for a cheaper (m, 2·bn)→cand cut.
+        # The exact f32 rescore below keeps the *ranking* exact either way.
+        neg, pos = jax.lax.approx_max_k(-sv, cand, recall_target=0.99)
+    else:
+        neg, pos = jax.lax.top_k(-sv, cand)
     sel_sv = -neg
     short = jnp.take_along_axis(si, pos, axis=1)
     dc = _exact_candidate_distances(x, y[short], metric)
@@ -216,6 +223,7 @@ def knn(
     tile: int = 8192,
     mode: str = "exact",
     cand: int = 64,
+    cut: str = "exact",
     filter=None,
     res=None,
 ) -> Tuple[jax.Array, jax.Array]:
@@ -224,7 +232,9 @@ def knn(
     inner_product}.  ``mode="exact"`` (default) or ``"fast"`` (bf16 MXU
     shortlist + exact refine; recall@k ≥ ~0.999, ~3.5× faster — see
     module docstring).  ``cand`` is the fast-mode shortlist width
-    (≥ 4·k recommended).
+    (≥ 4·k recommended); ``cut`` picks the (m, shortlist)→cand
+    reduction — ``"exact"`` (lax.top_k) or ``"approx"``
+    (``approx_max_k`` at recall_target 0.99, cheaper on TPU).
 
     ``filter``: optional prefilter (``core.Bitset`` or (n,) bools, True =
     keep) — filtered database rows never appear in results (cuVS
@@ -240,9 +250,10 @@ def knn(
     from ._packing import as_keep_mask, sentinel_filtered_ids
 
     keep = as_keep_mask(filter, y.shape[0])
+    expects(cut in ("exact", "approx"), f"unknown cut {cut!r}")
     if mode == "fast":
         vals, ids = _fast_knn_impl(x, y, int(k), metric, int(max(cand, k)),
-                                   1024, 1024, keep)
+                                   1024, 1024, keep, cut)
     else:
         vals, ids = _knn_impl(x, y, int(k), metric,
                               int(min(tile, max(y.shape[0], 1))), keep)

@@ -102,6 +102,19 @@ def test_knn_fast_mode(rng, metric):
                                rtol=2e-2, atol=2e-2)
 
 
+def test_knn_fast_mode_approx_cut(rng):
+    """cut='approx' (approx_max_k shortlist cut) must stay near-exact —
+    the final ranking is still an exact f32 rescore.  n > one 65536-row
+    tile, so the CPU-fallback shortlist (kk per tile, concatenated) is
+    wider than cand and the cut genuinely selects (cand of 2·cand) —
+    with n <= tile the cut is width-preserving and the test is vacuous."""
+    x = rng.standard_normal((8, 8)).astype(np.float32)
+    y = rng.standard_normal((70_000, 8)).astype(np.float32)
+    _, i_ref = knn(x, y, 5)
+    _, i = knn(x, y, 5, mode="fast", cand=32, cut="approx")
+    assert float(neighborhood_recall(np.asarray(i), np.asarray(i_ref))) >= 0.95
+
+
 def test_knn_sharded_ring_matches_gather(rng, mesh8):
     x = rng.standard_normal((10, 8)).astype(np.float32)
     y = rng.standard_normal((160, 8)).astype(np.float32)
