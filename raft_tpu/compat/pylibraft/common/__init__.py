@@ -12,7 +12,11 @@ import numpy as np
 
 from raft_tpu.core import DeviceResources
 
-__all__ = ["Handle", "DeviceResources", "device_ndarray", "fill_out"]
+from . import interruptible, outputs  # noqa: F401  (upstream submodules)
+from .outputs import auto_convert_output
+
+__all__ = ["Handle", "DeviceResources", "device_ndarray", "fill_out",
+           "auto_convert_output", "interruptible", "outputs"]
 
 # the core handle already carries sync(*arrays) (resources.py:150)
 Handle = DeviceResources  # deprecated alias, as upstream

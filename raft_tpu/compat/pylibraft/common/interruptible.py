@@ -1,0 +1,27 @@
+"""``pylibraft.common.interruptible`` parity (``common/interruptible.pyx``):
+the ``synchronize``/``cancel`` pair with SIGINT → cooperative cancellation,
+backed by :mod:`raft_tpu.core.interruptible`.
+
+>>> import jax.numpy as jnp
+>>> _ = synchronize(jnp.ones((2,)))      # completes; no pending cancel
+>>> cancel()                             # flag the process
+>>> try:
+...     _ = synchronize(jnp.ones((2,)))
+... except InterruptedException:
+...     print("cancelled")
+cancelled
+"""
+
+from __future__ import annotations
+
+from raft_tpu.core.interruptible import (  # noqa: F401
+    InterruptedException,
+    cancel,
+    clear,
+    install_sigint_handler,
+    synchronize,
+    yield_now,
+)
+
+__all__ = ["InterruptedException", "cancel", "clear",
+           "install_sigint_handler", "synchronize", "yield_now"]

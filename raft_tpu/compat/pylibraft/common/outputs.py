@@ -1,0 +1,65 @@
+"""``pylibraft.common.outputs`` parity: the ``auto_convert_output``
+decorator (``common/outputs.py``) honoring :mod:`..config`'s policy.
+
+Wrapped functions may return a ``jax.Array``, a tuple/list of them, or
+anything else (passed through untouched — e.g. a preallocated ``out``
+that was filled in place).
+
+>>> from raft_tpu.compat.pylibraft import config
+>>> import jax.numpy as jnp, numpy as np
+>>> @auto_convert_output
+... def f():
+...     return jnp.arange(3), "tag"
+>>> config.set_output_as("numpy")
+>>> out, tag = f()
+>>> type(out).__name__, tag
+('ndarray', 'tag')
+>>> config.set_output_as("raft")
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from .. import config
+
+__all__ = ["auto_convert_output"]
+
+
+def _convert_leaf(x):
+    import jax
+
+    if not isinstance(x, jax.Array):
+        return x
+    policy = config.output_as_
+    if callable(policy):
+        return policy(x)
+    if policy == "raft":
+        return x
+    if policy == "numpy":
+        return np.asarray(x)
+    if policy == "torch":
+        import torch
+
+        # copy: np.asarray(jax.Array) aliases JAX's read-only host cache,
+        # and an in-place torch op on that buffer would corrupt it
+        return torch.from_numpy(np.asarray(x).copy())
+    raise ValueError(f"unknown output_as policy {policy!r}")
+
+
+def auto_convert_output(f):
+    """Convert ``jax.Array`` results per the global policy (upstream
+    ``@auto_convert_output``)."""
+
+    @functools.wraps(f)
+    def wrapper(*args, **kwargs):
+        ret = f(*args, **kwargs)
+        if isinstance(ret, tuple) and hasattr(ret, "_fields"):  # namedtuple
+            return type(ret)(*(_convert_leaf(v) for v in ret))
+        if isinstance(ret, (tuple, list)):
+            return type(ret)(_convert_leaf(v) for v in ret)
+        return _convert_leaf(ret)
+
+    return wrapper

@@ -1,0 +1,38 @@
+"""``pylibraft.config`` parity: process-wide output-conversion policy
+(``python/pylibraft/pylibraft/config.py``).
+
+Upstream lets callers pick what device arrays come back as
+(``set_output_as("cupy"|"torch"|callable)``).  The TPU analog converts
+``jax.Array`` outputs: ``"raft"`` (default — committed ``jax.Array``),
+``"numpy"`` (host copy), ``"torch"`` (CPU torch tensor), or any callable
+``jax.Array -> anything``.
+
+>>> set_output_as("numpy")
+>>> get_output_as()
+'numpy'
+>>> set_output_as("raft")
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+__all__ = ["set_output_as", "get_output_as", "SUPPORTED_OUTPUT_TYPES"]
+
+SUPPORTED_OUTPUT_TYPES = ("raft", "numpy", "torch")
+
+output_as_: Union[str, Callable] = "raft"
+
+
+def set_output_as(output: Union[str, Callable]) -> None:
+    """Set the global output conversion (upstream ``config.set_output_as``)."""
+    if not callable(output) and output not in SUPPORTED_OUTPUT_TYPES:
+        raise ValueError(
+            f"output_as must be callable or one of {SUPPORTED_OUTPUT_TYPES}, "
+            f"got {output!r}")
+    global output_as_
+    output_as_ = output
+
+
+def get_output_as() -> Union[str, Callable]:
+    return output_as_

@@ -33,8 +33,11 @@ def pairwise_distance(X, Y=None, out=None, metric="euclidean", p=2.0,
     from raft_tpu.distance.pairwise import pairwise_distance as _pd
 
     from ..common import fill_out
+    from ..common.outputs import auto_convert_output
 
-    dist = _pd(X, Y, metric, p=float(p))
-    if out is not None:
-        return fill_out(out, dist)
-    return dist
+    @auto_convert_output
+    def _run():  # honors config.set_output_as; filled `out` passes through
+        dist = _pd(X, Y, metric, p=float(p))
+        return fill_out(out, dist) if out is not None else dist
+
+    return _run()

@@ -111,3 +111,46 @@ def test_handle_sync_accepts_arrays():
     from raft_tpu.compat.pylibraft.common import Handle
     import jax.numpy as jnp
     Handle().sync(jnp.zeros(3))  # per-buffer sync path kept from core
+
+
+def test_output_conversion_policy():
+    from raft_tpu.compat.pylibraft import config
+    from raft_tpu.compat.pylibraft.distance import pairwise_distance
+    x = np.eye(4, dtype=np.float32)
+    try:
+        config.set_output_as("numpy")
+        assert isinstance(pairwise_distance(x), np.ndarray)
+        config.set_output_as(lambda a: "custom")
+        assert pairwise_distance(x) == "custom"
+        with pytest.raises(ValueError):
+            config.set_output_as("cupy")  # no CUDA on TPU builds
+    finally:
+        config.set_output_as("raft")
+    import jax
+    assert isinstance(pairwise_distance(x), jax.Array)
+
+
+def test_output_conversion_torch():
+    torch = pytest.importorskip("torch")
+    from raft_tpu.compat.pylibraft import config
+    from raft_tpu.compat.pylibraft.distance import pairwise_distance
+    x = np.eye(4, dtype=np.float32)
+    try:
+        config.set_output_as("torch")
+        t = pairwise_distance(x)
+        assert isinstance(t, torch.Tensor)
+        before = np.asarray(pairwise_distance(x.copy()))
+        t.add_(1.0)  # must not corrupt JAX's cached host buffer (copy made)
+        after = np.asarray(pairwise_distance(x.copy()))
+        np.testing.assert_array_equal(before, after)
+    finally:
+        config.set_output_as("raft")
+
+
+def test_interruptible_surface():
+    from raft_tpu.compat.pylibraft.common import interruptible
+    interruptible.clear()
+    interruptible.cancel()
+    with pytest.raises(interruptible.InterruptedException):
+        interruptible.synchronize()
+    interruptible.synchronize()  # flag auto-cleared on raise
