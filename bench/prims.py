@@ -122,6 +122,38 @@ def bench_norm(quick):
     report("norm", "16384x512_l2", t, x.size)
 
 
+def bench_normalize(quick):
+    from raft_tpu.linalg import normalize
+
+    key = jax.random.PRNGKey(7)
+    x = jax.block_until_ready(jax.random.normal(key, (16384, 512), jnp.float32))
+    t = _time(lambda: normalize(x))
+    report("normalize", "16384x512_l2", t, x.size)
+
+
+def bench_argmin(quick):
+    from raft_tpu.matrix import argmin
+
+    key = jax.random.PRNGKey(8)
+    x = jax.block_until_ready(jax.random.normal(key, (8192, 4096), jnp.float32))
+    t = _time(lambda: argmin(x))
+    report("argmin", "8192x4096_rows", t, x.size)
+
+
+def bench_copy(quick):
+    """The mdspan-copy role (``bench/prims`` has a copy suite): host→device
+    ingest of an F-order array and device→host F-order export."""
+    from raft_tpu.core.copy import copy
+
+    h = np.asfortranarray(np.random.default_rng(9).standard_normal(
+        (4096, 1024)).astype(np.float32))
+    t = _time(lambda: copy(h, memory="device"))
+    report("copy", "F_host_to_device_4096x1024", t, h.size)
+    d = copy(np.ascontiguousarray(h), memory="device")
+    t = _time(lambda: copy(d, memory="host", layout="F"))
+    report("copy", "device_to_F_host_4096x1024", t, h.size)
+
+
 def bench_gather(quick):
     from raft_tpu.matrix import gather
 
@@ -210,6 +242,9 @@ SUITES = {
     "select_k": bench_select_k,
     "reduce": bench_reduce,
     "norm": bench_norm,
+    "normalize": bench_normalize,
+    "argmin": bench_argmin,
+    "copy": bench_copy,
     "gather": bench_gather,
     "rng": bench_rng,
     "make_blobs": bench_make_blobs,
