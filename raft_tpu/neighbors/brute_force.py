@@ -148,15 +148,37 @@ def _fast_knn_impl(x, y, k: int, metric: str, cand: int, bm: int, bn: int,
     m, d = x.shape
     n = y.shape[0]
     if metric == "cosine":
-        xs = x / jnp.sqrt(jnp.maximum(jnp.sum(x * x, axis=1, keepdims=True), 1e-30))
-        ys = y / jnp.sqrt(jnp.maximum(jnp.sum(y * y, axis=1, keepdims=True), 1e-30))
+        # normalize in f32: integer squares would wrap in-dtype (200² mod
+        # 256), so cast before the norm sums
+        xf32, yf32 = x.astype(jnp.float32), y.astype(jnp.float32)
+        xs = xf32 / jnp.sqrt(jnp.maximum(
+            jnp.sum(xf32 * xf32, axis=1, keepdims=True), 1e-30))
+        ys = yf32 / jnp.sqrt(jnp.maximum(
+            jnp.sum(yf32 * yf32, axis=1, keepdims=True), 1e-30))
     else:
         xs, ys = x, y
+    # int8 MXU path: BOTH sides must be the same integer dtype, and only
+    # for L2 metrics (centering shifts inner-product rankings per row)
+    integer = (xs.dtype == ys.dtype and ys.dtype in (jnp.uint8, jnp.int8)
+               and metric != "inner_product")
     if metric == "inner_product":
         yn = jnp.zeros((n,), jnp.float32)
+    elif integer:
+        # center uint8 to int8 once, fold the correction into the
+        # surrogate norms (per-query terms drop out of the ranking); the
+        # CPU fallback scores the same centered values in bf16
+        from ..ops.pallas.fused_l2_topk import center_int8, int8_surrogate_norms
+
+        yn = int8_surrogate_norms(ys)
+        xs, ys = center_int8(xs), center_int8(ys)
     else:
         ysf = ys.astype(jnp.float32)
         yn = jnp.sum(ysf * ysf, axis=1)
+    if not integer and (jnp.issubdtype(xs.dtype, jnp.integer)
+                        or jnp.issubdtype(ys.dtype, jnp.integer)):
+        # mixed or non-L2 integer inputs take the float path (≤255 is
+        # bf16-exact); also keeps fused_shortlist's dtype-equality contract
+        xs, ys = xs.astype(jnp.float32), ys.astype(jnp.float32)
     if keep is not None:
         yn = jnp.where(keep, yn, jnp.inf)
 

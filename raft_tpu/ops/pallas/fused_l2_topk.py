@@ -49,7 +49,13 @@ def _kernel(x_ref, y_ref, yn_ref, v1_ref, i1_ref, v2_ref, i2_ref):
         v2_ref[:] = jnp.full_like(v2_ref, jnp.inf)
         i2_ref[:] = jnp.full_like(i2_ref, -1)
 
-    dots = jnp.dot(x_ref[:], y_ref[:].T, preferred_element_type=jnp.float32)
+    if x_ref.dtype == jnp.int8:
+        # int8 MXU pass (2x bf16 rate, int32 accumulation — exact)
+        dots = jnp.dot(x_ref[:], y_ref[:].T,
+                       preferred_element_type=jnp.int32).astype(jnp.float32)
+    else:
+        dots = jnp.dot(x_ref[:], y_ref[:].T,
+                       preferred_element_type=jnp.float32)
     dist = yn_ref[:] - 2.0 * dots                     # (BM, BN); ‖x‖² added later
     # a bucket's winning column ≡ its lane position (mod BN): storing the
     # int16 n-block id alone identifies the column — no per-lane iota pass
@@ -112,11 +118,16 @@ def fused_shortlist(
     bn: int = 2048,
 ) -> Tuple[jax.Array, jax.Array]:
     """Per-query shortlist of ``2*bn`` nearest candidates by
-    ``‖y‖² − 2·x·yᵀ`` (monotone in L2 distance for fixed query).
+    ``yn − 2·x·yᵀ`` (monotone in L2 distance for fixed query when ``yn``
+    is ``‖y‖²`` — or any per-column offset with the same property).
 
-    ``x``/``y`` are cast to bf16 for the MXU pass; ``yn`` must be the f32
-    squared norms of ``y``'s rows.  Returns ``(values, column_ids)`` of
-    shape ``(m, 2*bn)`` — *unsorted*; exact re-scoring is the caller's
+    Float inputs are cast to bf16 for the MXU pass.  **int8 inputs run an
+    int8 MXU pass** (2× the bf16 rate, exact int32 accumulation) —
+    ``uint8`` corpora (SIFT/bigann-style) are centered to int8 with the
+    correction folded into ``yn`` (see :func:`int8_surrogate_norms`; the
+    per-*query* correction term is constant within a row and drops out of
+    the ranking).  ``yn`` must be f32.  Returns ``(values, column_ids)``
+    of shape ``(m, 2*bn)`` — *unsorted*; exact re-scoring is the caller's
     job.  Padded database rows get ``yn = +inf`` so they never surface.
 
     The int16 block-id encoding bounds the database at ``32767 * bn`` rows
@@ -129,6 +140,11 @@ def fused_shortlist(
     expects(n <= 32767 * bn,
             f"database rows {n} exceed int16 block-id range ({32767 * bn}) "
             f"at bn={bn}; shard the database or raise bn")
+    expects(x.dtype == y.dtype, f"x/y dtype mismatch {x.dtype} vs {y.dtype}")
+    if x.dtype == jnp.uint8:
+        # center to int8 BEFORE padding (pad zeros must stay zeros)
+        x = center_int8(x)
+        y = center_int8(y)
     # pad feature dim to lane width for the MXU (zeros don't change dots)
     dpad = (-d) % 128
     if dpad:
@@ -139,7 +155,36 @@ def fused_shortlist(
         y = jnp.pad(y, ((0, npad), (0, 0)))
         yn = jnp.pad(yn, (0, npad), constant_values=jnp.inf)
     bm = min(bm, max(8, m))
-    xb = x.astype(jnp.bfloat16)
-    yb = y.astype(jnp.bfloat16)
+    if x.dtype != jnp.int8:
+        x = x.astype(jnp.bfloat16)
+        y = y.astype(jnp.bfloat16)
     interpret = jax.default_backend() != "tpu"
-    return _call(xb, yb, yn.reshape(1, -1).astype(jnp.float32), bm, bn, interpret)
+    return _call(x, y, yn.reshape(1, -1).astype(jnp.float32), bm, bn, interpret)
+
+
+def center_int8(a: jax.Array) -> jax.Array:
+    """``uint8 → int8`` zero-point shift (``a − 128``) — THE centering the
+    int8 kernel path scores; :func:`int8_surrogate_norms` is its paired
+    ``yn`` convention.  int8 passes through unchanged."""
+    if a.dtype == jnp.uint8:
+        return (a.astype(jnp.int16) - 128).astype(jnp.int8)
+    return a
+
+
+def int8_surrogate_norms(y: jax.Array) -> jax.Array:
+    """The ``yn`` vector for integer datasets fed to :func:`fused_shortlist`.
+
+    For ``int8`` rows this is plainly ``‖y‖²``.  For ``uint8`` rows the
+    kernel scores centered values ``y' = y − 128``, so the surrogate
+    needs ``yn' = ‖y‖² − 256·Σy``: with ``x' = x − 128``,
+
+    ``‖y‖² − 2·x·y = (‖y‖² − 256·Σy) − 2·x'·y' − 256·Σx' − 32768·d``
+
+    and the last two terms are constant per *query*, leaving the per-row
+    ranking unchanged.  Exact in f32 (both terms ≤ 2²³ for d ≤ 128).
+    """
+    yf = y.astype(jnp.float32)
+    yn = jnp.sum(yf * yf, axis=1)
+    if y.dtype == jnp.uint8:
+        return yn - 256.0 * jnp.sum(yf, axis=1)
+    return yn

@@ -28,6 +28,8 @@ run_step() {  # name, command...
   fi
 }
 
+# 0. int8 MXU shortlist path must compile+rank on the real chip
+run_step int8 python scripts/tpu_validate_int8.py
 # 1. kernel profile + block-size sweep (informs any tuning before bench)
 run_step profile python bench/profile_knn.py
 # 2. select_k tuner re-run (fori_loop kernel fix may change winners/fix k=32)

@@ -78,3 +78,24 @@ def test_knn_bfloat16_inputs(int_data):
     # each query is a database row: bf16 ingest must still find exactly it
     np.testing.assert_array_equal(np.asarray(i)[:, 0], sel)
     assert float(np.asarray(v)[:, 0].max()) <= 1e-3
+
+
+def test_knn_uint8_cosine_fast_matches_exact(int_data):
+    db, q, _ = int_data
+    vf, i_ref = brute_force.knn(q, db, 5, metric="cosine")
+    v, i = brute_force.knn(q, db, 5, metric="cosine", mode="fast", cand=64)
+    from raft_tpu.stats import neighborhood_recall
+
+    assert float(neighborhood_recall(np.asarray(i), np.asarray(i_ref))) >= 0.99
+
+
+def test_knn_mixed_dtype_queries(int_data):
+    """f32 queries against an integer database must take the float path
+    (no silent truncation through the int8 centering)."""
+    db, q, _ = int_data
+    qf = q.astype(np.float32) + 0.25  # real-valued: would corrupt if cast
+    _, i_ref = brute_force.knn(qf, db.astype(np.float32), 5)
+    _, i = brute_force.knn(qf, db, 5, mode="fast", cand=64)
+    from raft_tpu.stats import neighborhood_recall
+
+    assert float(neighborhood_recall(np.asarray(i), np.asarray(i_ref))) >= 0.99

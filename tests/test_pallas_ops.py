@@ -50,3 +50,28 @@ def test_fused_shortlist_padding(rng):
     si, sv = np.asarray(si), np.asarray(sv)
     finite = np.isfinite(sv)
     assert np.all(si[finite] >= 0) and np.all(si[finite] < n)
+
+
+@pytest.mark.parametrize("dtype", [np.uint8, np.int8])
+def test_fused_shortlist_int8_path(rng, dtype):
+    """Integer inputs take the int8 MXU branch (centered for uint8, with
+    the correction folded into yn) — the true L2 top-k must still be in
+    the shortlist."""
+    from raft_tpu.ops.pallas.fused_l2_topk import int8_surrogate_norms
+
+    m, n, d, k = 16, 3000, 32, 10
+    if dtype == np.uint8:
+        x = rng.integers(0, 256, (m, d)).astype(dtype)
+        y = rng.integers(0, 256, (n, d)).astype(dtype)
+    else:
+        x = rng.integers(-128, 128, (m, d)).astype(dtype)
+        y = rng.integers(-128, 128, (n, d)).astype(dtype)
+    yn = int8_surrogate_norms(jnp.asarray(y))
+    _, si = fused_shortlist(jnp.asarray(x), jnp.asarray(y), yn,
+                            bm=16, bn=512)
+    si = np.asarray(si)
+    d2 = ((x.astype(np.int64)[:, None, :]
+           - y.astype(np.int64)[None, :, :]) ** 2).sum(-1)
+    true = np.argsort(d2, axis=1)[:, :k]
+    rec = np.mean([len(set(t) & set(s)) for t, s in zip(true, si)]) / k
+    assert rec > 0.99, rec
