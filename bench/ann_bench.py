@@ -51,26 +51,42 @@ def parse_synthetic(spec: str):
     return n, d, int(parts[2]) if len(parts) > 2 else 0
 
 
-def load_matrix(spec: str, what: str, n_clusters: int = 0):
+def load_matrix(spec: str, what: str, n_clusters: int = 0,
+                dtype: str = "native"):
     """Dataset file (.npy/.fvecs/.bvecs) or ``synthetic:NxD[:seed]``.
     ``n_clusters`` (from the base spec) keeps held-out queries on the
     SAME cluster centers — make_clustered only shares centers across
-    calls with equal ``n_clusters``."""
+    calls with equal ``n_clusters``.  ``dtype``: "native" keeps the file
+    dtype (uint8 .bvecs rides the int8 MXU fast path and 4x-smaller
+    lists), "f32" casts up, "uint8" quantizes synthetic data to 0..255
+    (SIFT-style corpora)."""
     if spec.startswith("synthetic:"):
         n, d, seed = parse_synthetic(spec)
-        return make_clustered(n, d, n_clusters or max(64, n // 1000),
-                              seed=seed, scale=2.0,
-                              point_seed=1 if what == "query" else 0)
+        out = make_clustered(n, d, n_clusters or max(64, n // 1000),
+                             seed=seed, scale=2.0,
+                             point_seed=1 if what == "query" else 0)
+        if dtype == "uint8":
+            import jax.numpy as jnp
+
+            out = jnp.clip(jnp.round(out * 16.0 + 128.0), 0, 255
+                           ).astype(jnp.uint8)
+        return out
     from raft_tpu import io as rio
 
     ext = os.path.splitext(spec)[1]
     if ext == ".npy":
-        return rio.read_npy(spec)
-    if ext == ".fvecs":
-        return rio.read_fvecs(spec)
-    if ext == ".bvecs":
-        return rio.read_bvecs(spec).astype(np.float32)
-    raise SystemExit(f"{what}: unsupported dataset format {ext!r}")
+        out = rio.read_npy(spec)
+    elif ext == ".fvecs":
+        out = rio.read_fvecs(spec)
+    elif ext == ".bvecs":
+        out = rio.read_bvecs(spec)
+    else:
+        raise SystemExit(f"{what}: unsupported dataset format {ext!r}")
+    if dtype == "uint8" and out.dtype != np.uint8:
+        raise SystemExit(f"{what}: --dtype uint8 only quantizes synthetic: "
+                         "specs; float file data has no canonical 0..255 "
+                         "scale (use a .bvecs file or --dtype native/f32)")
+    return out.astype(np.float32) if dtype == "f32" else out
 
 
 def load_gt(spec, queries, base, k, metric):
@@ -101,6 +117,11 @@ def main() -> None:
     ap.add_argument("--sweep", default=None,
                     help="ivf: probe list '8,16,32'; cagra: 'itopk:width,...'")
     ap.add_argument("--recall-floor", type=float, default=0.95)
+    ap.add_argument("--dtype", choices=("native", "f32", "uint8"),
+                    default="native",
+                    help="native: keep file dtype (uint8 .bvecs stays "
+                         "uint8); f32: cast up; uint8: quantize synthetic "
+                         "data SIFT-style")
     ap.add_argument("--chunked", action="store_true",
                     help="stream the build from host (out-of-core)")
     ap.add_argument("--sharded", type=int, default=0, metavar="S",
@@ -108,15 +129,15 @@ def main() -> None:
                          "(ivf_flat/ivf_pq/cagra)")
     args = ap.parse_args()
 
-    base = load_matrix(args.base, "base")
+    base = load_matrix(args.base, "base", dtype=args.dtype)
     if args.query:
-        q = load_matrix(args.query, "query")
+        q = load_matrix(args.query, "query", dtype=args.dtype)
     elif args.base.startswith("synthetic:"):
         nb, d0, seed = parse_synthetic(args.base)
         nq = min(10_000, nb // 10)
         # same n_clusters as the base → same centers, held-out points
         q = load_matrix(f"synthetic:{nq}x{d0}:{seed}", "query",
-                        n_clusters=max(64, nb // 1000))
+                        n_clusters=max(64, nb // 1000), dtype=args.dtype)
     else:
         q = np.asarray(base[:10_000])
     n, d = base.shape
