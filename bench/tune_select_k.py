@@ -71,6 +71,11 @@ def main() -> None:
                        "raft_tpu", "matrix", "_select_k_table.json")
     with open(out, "w") as f:
         json.dump(table, f, indent=1, sort_keys=True)
+    # provenance sidecar: NOT in the dispatch table (whose consumers —
+    # dispatch, tests — treat every key as a b:l:k bucket)
+    with open(out.replace(".json", ".meta.json"), "w") as f:
+        json.dump({"backend": jax.default_backend(),
+                   "n_entries": len(table)}, f)
     print(f"wrote {len(table)} entries → {os.path.normpath(out)}")
 
 
