@@ -77,3 +77,60 @@ def test_artifacts_are_plain_npy(tmp_path, rng):
     # interop: plain numpy can read every array artifact
     got = np.load(tmp_path / "npy" / "centroids.npy")
     np.testing.assert_array_equal(got, np.asarray(idx.centroids))
+
+def test_orbax_checkpoint_roundtrip(tmp_path, rng):
+    """Orbax tier: parallel/sharded checkpointing (SURVEY.md §5.4's
+    'orbax-style checkpoint' role)."""
+    pytest.importorskip("orbax.checkpoint")
+    from raft_tpu.neighbors.ivf_flat import IvfFlatIndexParams, build, search
+    from raft_tpu.neighbors.serialize import (load_index_checkpoint,
+                                              save_index_checkpoint)
+
+    x = _blobs(rng)
+    idx = build(x, IvfFlatIndexParams(n_lists=8, kmeans_n_iters=4))
+    save_index_checkpoint(tmp_path / "ockpt", idx)
+    idx2 = load_index_checkpoint(tmp_path / "ockpt")
+    d1, i1 = search(idx, x[:10], 5)
+    d2, i2 = search(idx2, x[:10], 5)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+    np.testing.assert_allclose(np.asarray(d1), np.asarray(d2))
+    assert idx2.metric == idx.metric
+
+
+def test_orbax_checkpoint_sharded_restore(tmp_path, rng, mesh8):
+    """shardings= restores fields directly into a mesh placement."""
+    pytest.importorskip("orbax.checkpoint")
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from raft_tpu.neighbors.ivf_flat import IvfFlatIndexParams, build
+    from raft_tpu.neighbors.serialize import (load_index_checkpoint,
+                                              save_index_checkpoint)
+
+    x = _blobs(rng)
+    idx = build(x, IvfFlatIndexParams(n_lists=8, kmeans_n_iters=4))
+    save_index_checkpoint(tmp_path / "ockpt", idx)
+    s = NamedSharding(mesh8, P("shard"))
+    idx2 = load_index_checkpoint(tmp_path / "ockpt",
+                                 shardings={"data": s, "ids": s})
+    assert idx2.data.sharding.is_equivalent_to(s, idx2.data.ndim)
+    np.testing.assert_array_equal(np.asarray(idx2.data), np.asarray(idx.data))
+
+
+def test_orbax_checkpoint_pq_rebuilds_recon(tmp_path, rng):
+    pytest.importorskip("orbax.checkpoint")
+    from raft_tpu.neighbors.ivf_pq import IvfPqIndexParams, build, search
+    from raft_tpu.neighbors.serialize import (load_index_checkpoint,
+                                              save_index_checkpoint)
+
+    x = _blobs(rng)
+    idx = build(x, IvfPqIndexParams(n_lists=8, pq_dim=8, kmeans_n_iters=4))
+    save_index_checkpoint(tmp_path / "pq", idx)
+    idx2 = load_index_checkpoint(tmp_path / "pq")
+    assert idx2.recon is not None  # derived tier rebuilt, never serialized
+    import os
+    names = {f for _, _, fs in os.walk(tmp_path / "pq") for f in fs}
+    assert not any("recon" in n for n in names)
+    d1, i1 = search(idx, x[:10], 5)
+    d2, i2 = search(idx2, x[:10], 5)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
