@@ -5,6 +5,6 @@ implementations.  CUDA-specific surfaces (streams, __cuda_array_interface__)
 have no TPU meaning and are represented by host/device-array equivalents.
 """
 
-from . import common, config, distance, random, sparse  # noqa: F401
+from . import common, config, distance, neighbors, random, sparse  # noqa: F401
 
 __version__ = "26.08.00+tpu"

@@ -1,0 +1,20 @@
+"""``pylibraft.neighbors`` parity — the pre-cuVS upstream surface
+(``python/pylibraft/pylibraft/neighbors`` in reference history; the
+north star's "expose everything through pylibraft unchanged").
+
+Upstream call convention, kept verbatim::
+
+    from raft_tpu.compat.pylibraft.neighbors import ivf_pq
+    index = ivf_pq.build(ivf_pq.IndexParams(n_lists=1024), dataset)
+    dist, ids = ivf_pq.search(ivf_pq.SearchParams(n_probes=32),
+                              index, queries, k=10)
+
+i.e. ``build(IndexParams, dataset)`` / ``search(SearchParams, index,
+queries, k)`` — params-first argument order, upstream metric naming
+(``"sqeuclidean"``/``"euclidean"``/``"inner_product"``), optional
+``handle=`` accepted everywhere (the TPU handle carries no streams, so
+it is accepted for signature parity and unused).
+"""
+
+from . import brute_force, cagra, ivf_flat, ivf_pq  # noqa: F401
+from .refine import refine  # noqa: F401

@@ -1,0 +1,42 @@
+"""``pylibraft.neighbors.brute_force`` parity: the ``knn()`` entry point."""
+
+from __future__ import annotations
+
+from ..common.outputs import auto_convert_output
+
+__all__ = ["knn"]
+
+
+def knn(dataset, queries, k, indices=None, distances=None,
+        metric="sqeuclidean", metric_arg=2.0, global_id_offset=0,
+        handle=None):
+    """Exact brute-force kNN, upstream argument order (dataset first;
+    optional preallocated ``indices``/``distances`` outputs are filled
+    and returned).
+
+    >>> import numpy as np
+    >>> x = np.random.default_rng(0).standard_normal((100, 8)).astype(np.float32)
+    >>> d, i = knn(x, x[:5], 3)
+    >>> bool((np.asarray(i)[:, 0] == np.arange(5)).all())
+    True
+    """
+    from raft_tpu.neighbors.brute_force import knn as _knn
+
+    from ..common import fill_out
+
+    d, i = _knn(queries, dataset, int(k), metric=metric)
+    if global_id_offset:
+        i = i + int(global_id_offset)
+    return _finish_out(d, i, distances, indices, fill_out)
+
+
+def _finish_out(d, i, distances, indices, fill_out):
+    """Upstream out-parameter contract shared by knn/refine: fill the
+    preallocated buffers when given, else honor the output policy."""
+    if distances is not None:
+        d = fill_out(distances, d)
+    if indices is not None:
+        i = fill_out(indices, i)
+    if distances is None and indices is None:
+        return auto_convert_output(lambda: (d, i))()
+    return d, i

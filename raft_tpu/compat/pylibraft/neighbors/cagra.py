@@ -1,0 +1,70 @@
+"""``pylibraft.neighbors.cagra`` parity: params-first build/search."""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..common.outputs import auto_convert_output
+
+__all__ = ["IndexParams", "SearchParams", "build", "search"]
+
+
+@dataclasses.dataclass(frozen=True)
+class IndexParams:
+    """Upstream field names; ``build_algo`` keeps the upstream vocabulary
+    (``"ivf_pq"`` selects the IVF-sourced graph build, ``"nn_descent"``
+    maps to the brute-force kNN-graph build — exact, which dominates
+    NN-descent quality at TPU matmul rates)."""
+
+    metric: str = "sqeuclidean"
+    intermediate_graph_degree: int = 128
+    graph_degree: int = 64
+    build_algo: str = "ivf_pq"
+
+
+@dataclasses.dataclass(frozen=True)
+class SearchParams:
+    """``num_random_samplings`` scales the native entry-seed count
+    (``n_seeds = 32 · num_random_samplings``).  ``max_queries`` is
+    accepted for parity; XLA batches any query count without a cap."""
+
+    max_queries: int = 0
+    itopk_size: int = 64
+    max_iterations: int = 0
+    search_width: int = 1
+    num_random_samplings: int = 1
+
+
+def build(index_params: IndexParams, dataset, handle=None):
+    """``build(IndexParams, dataset)`` → index (upstream argument order).
+
+    >>> import numpy as np
+    >>> x = np.random.default_rng(0).standard_normal((400, 16)).astype(np.float32)
+    >>> idx = build(IndexParams(intermediate_graph_degree=16, graph_degree=8,
+    ...                         build_algo="nn_descent"), x)
+    >>> d, i = search(SearchParams(itopk_size=32, search_width=4),
+    ...               idx, x[:4], 3)
+    >>> bool((np.asarray(i)[:, 0] == np.arange(4)).all())
+    True
+    """
+    from raft_tpu.neighbors import cagra as _native
+
+    algo = "ivf" if index_params.build_algo == "ivf_pq" else "brute_force"
+    return _native.build(dataset, _native.CagraIndexParams(
+        metric=index_params.metric,
+        intermediate_graph_degree=index_params.intermediate_graph_degree,
+        graph_degree=index_params.graph_degree,
+        build_algo=algo))
+
+
+@auto_convert_output
+def search(search_params: SearchParams, index, queries, k, handle=None):
+    from raft_tpu.neighbors import cagra as _native
+
+    return _native.search(
+        index, queries, int(k),
+        _native.CagraSearchParams(
+            itopk_size=int(search_params.itopk_size),
+            search_width=max(1, int(search_params.search_width)),
+            max_iterations=int(search_params.max_iterations),
+            n_seeds=32 * max(1, int(search_params.num_random_samplings))))

@@ -1,0 +1,82 @@
+"""``pylibraft.neighbors.ivf_flat`` parity: params-first build/search/extend."""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..common.outputs import auto_convert_output
+
+__all__ = ["IndexParams", "SearchParams", "build", "search", "extend"]
+
+
+@dataclasses.dataclass(frozen=True)
+class IndexParams:
+    """Upstream field names.  ``adaptive_centers`` accepted-but-fixed
+    (TPU builds re-fit centers); ``add_data_on_build=False`` trains the
+    quantizer on the dataset but leaves the lists empty for ``extend``.
+    """
+
+    n_lists: int = 1024
+    metric: str = "sqeuclidean"
+    kmeans_n_iters: int = 20
+    kmeans_trainset_fraction: float = 0.5
+    add_data_on_build: bool = True
+    adaptive_centers: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class SearchParams:
+    n_probes: int = 20
+
+
+def _native_params(p: IndexParams):
+    from raft_tpu.neighbors.ivf_flat import IvfFlatIndexParams
+
+    return IvfFlatIndexParams(
+        n_lists=p.n_lists, metric=p.metric, kmeans_n_iters=p.kmeans_n_iters,
+        kmeans_trainset_fraction=min(1.0, p.kmeans_trainset_fraction))
+
+
+def build(index_params: IndexParams, dataset, handle=None):
+    """``build(IndexParams, dataset)`` → index (upstream argument order).
+
+    >>> import numpy as np
+    >>> x = np.random.default_rng(0).standard_normal((256, 8)).astype(np.float32)
+    >>> idx = build(IndexParams(n_lists=8), x)
+    >>> d, i = search(SearchParams(n_probes=8), idx, x[:4], 3)
+    >>> bool((np.asarray(i)[:, 0] == np.arange(4)).all())
+    True
+    """
+    from raft_tpu.neighbors import ivf_flat as _native
+
+    idx = _native.build(dataset, _native_params(index_params))
+    if not index_params.add_data_on_build:
+        idx = _clear_lists(idx)
+    return idx
+
+
+def _clear_lists(idx):
+    """Train-only build: zero the occupancy (counts/ids) so stale rows
+    can never surface (search validity masks on both) and ``extend``
+    starts from an empty index with a trained quantizer."""
+    import dataclasses as _dc
+
+    import jax.numpy as jnp
+
+    return _dc.replace(idx, counts=jnp.zeros_like(idx.counts),
+                       ids=jnp.full_like(idx.ids, -1))
+
+
+@auto_convert_output
+def search(search_params: SearchParams, index, queries, k, handle=None):
+    from raft_tpu.neighbors import ivf_flat as _native
+
+    return _native.search(
+        index, queries, int(k),
+        _native.IvfFlatSearchParams(n_probes=int(search_params.n_probes)))
+
+
+def extend(index, new_vectors, new_indices=None, handle=None):
+    from raft_tpu.neighbors import ivf_flat as _native
+
+    return _native.extend(index, new_vectors, new_indices)

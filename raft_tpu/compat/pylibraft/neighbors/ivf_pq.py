@@ -1,0 +1,89 @@
+"""``pylibraft.neighbors.ivf_pq`` parity: params-first build/search/extend."""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..common.outputs import auto_convert_output
+
+__all__ = ["IndexParams", "SearchParams", "build", "search", "extend"]
+
+
+@dataclasses.dataclass(frozen=True)
+class IndexParams:
+    """Upstream field names.  ``codebook_kind`` is accepted for signature
+    parity; the TPU build trains per-subspace codebooks (the
+    ``per_subspace`` kind).  ``add_data_on_build=False`` trains the
+    quantizer+codebooks but leaves the lists empty for ``extend``."""
+
+    n_lists: int = 1024
+    metric: str = "sqeuclidean"
+    kmeans_n_iters: int = 20
+    kmeans_trainset_fraction: float = 0.5
+    pq_bits: int = 8
+    pq_dim: int = 0
+    codebook_kind: str = "subspace"
+    add_data_on_build: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class SearchParams:
+    """``lut_dtype`` selects the search tier: a reduced-precision LUT
+    request routes to the code-resident LUT tier; the default takes the
+    bf16 reconstruction tier.  ``internal_distance_dtype`` is accepted
+    for signature parity only — the recon tier already accumulates in
+    f32 over bf16 operands, which is what float16 internals ask for."""
+
+    n_probes: int = 20
+    lut_dtype: str = "float32"
+    internal_distance_dtype: str = "float32"
+
+
+def _native_params(p: IndexParams):
+    from raft_tpu.neighbors.ivf_pq import IvfPqIndexParams
+
+    return IvfPqIndexParams(
+        n_lists=p.n_lists, metric=p.metric, kmeans_n_iters=p.kmeans_n_iters,
+        kmeans_trainset_fraction=min(1.0, p.kmeans_trainset_fraction),
+        pq_bits=p.pq_bits, pq_dim=p.pq_dim)
+
+
+def build(index_params: IndexParams, dataset, handle=None):
+    """``build(IndexParams, dataset)`` → index (upstream argument order).
+
+    >>> import numpy as np
+    >>> x = np.random.default_rng(0).standard_normal((512, 16)).astype(np.float32)
+    >>> idx = build(IndexParams(n_lists=8, pq_dim=8), x)
+    >>> d, i = search(SearchParams(n_probes=8), idx, x[:4], 3)
+    >>> bool((np.asarray(i)[:, 0] == np.arange(4)).all())
+    True
+    """
+    from raft_tpu.neighbors import ivf_pq as _native
+
+    idx = _native.build(dataset, _native_params(index_params))
+    if not index_params.add_data_on_build:
+        from .ivf_flat import _clear_lists
+
+        idx = _clear_lists(idx)
+        if idx.recon is not None:
+            idx = idx.with_recon()  # re-derive the slab from cleared lists
+    return idx
+
+
+@auto_convert_output
+def search(search_params: SearchParams, index, queries, k, handle=None):
+    from raft_tpu.neighbors import ivf_pq as _native
+
+    mode = "auto"
+    if search_params.lut_dtype != "float32":
+        mode = "lut"  # reduced-precision LUT request → code-resident tier
+    return _native.search(
+        index, queries, int(k),
+        _native.IvfPqSearchParams(n_probes=int(search_params.n_probes),
+                                  mode=mode))
+
+
+def extend(index, new_vectors, new_indices=None, handle=None):
+    from raft_tpu.neighbors import ivf_pq as _native
+
+    return _native.extend(index, new_vectors, new_indices)

@@ -1,0 +1,28 @@
+"""``pylibraft.neighbors.refine`` parity: exact re-ranking of candidates."""
+
+from __future__ import annotations
+
+__all__ = ["refine"]
+
+
+def refine(dataset, queries, candidates, k, indices=None, distances=None,
+           metric="sqeuclidean", handle=None):
+    """Re-rank ``candidates`` (nq, n_cand) exactly against ``dataset``;
+    upstream argument order with optional preallocated
+    ``indices``/``distances`` outputs.
+
+    >>> import numpy as np
+    >>> from raft_tpu.compat.pylibraft.neighbors import brute_force
+    >>> x = np.random.default_rng(0).standard_normal((100, 8)).astype(np.float32)
+    >>> _, cand = brute_force.knn(x, x[:4], 10)
+    >>> d, i = refine(x, x[:4], cand, 3)
+    >>> bool((np.asarray(i)[:, 0] == np.arange(4)).all())
+    True
+    """
+    from raft_tpu.neighbors.refine import refine as _refine
+
+    from ..common import fill_out
+    from .brute_force import _finish_out
+
+    d, i = _refine(dataset, queries, candidates, int(k), metric=metric)
+    return _finish_out(d, i, distances, indices, fill_out)

@@ -36,6 +36,18 @@ def _index_registry():
             (IvfFlatIndex, IvfPqIndex, CagraIndex, ShardedCagraIndex)}
 
 
+def _validate_meta(meta, path):
+    """Shared metadata gate for both artifact tiers → the index class."""
+    type_name = meta.get("index_type")
+    registry = _index_registry()
+    if type_name not in registry:
+        raise ValueError(f"{path!r}: unknown or missing index_type {type_name!r}")
+    if meta.get("format_version", 0) > _FORMAT_VERSION:
+        raise ValueError(f"{path!r}: format_version {meta['format_version']} "
+                         f"is newer than supported {_FORMAT_VERSION}")
+    return registry[type_name]
+
+
 def save_index(path: Union[str, os.PathLike], index) -> None:
     """Persist any of the ANN index dataclasses (IVF-Flat, IVF-PQ, CAGRA,
     sharded CAGRA) to a directory of ``.npy`` files + JSON metadata."""
@@ -61,17 +73,11 @@ def load_index(path: Union[str, os.PathLike], *, device: bool = True):
     array fields on the default device; ``device=False`` keeps NumPy
     (useful to inspect or re-shard before transfer)."""
     arrays, meta = load_arrays(path)
-    type_name = meta.get("index_type")
-    registry = _index_registry()
-    if type_name not in registry:
-        raise ValueError(f"{path!r}: unknown or missing index_type {type_name!r}")
-    if meta.get("format_version", 0) > _FORMAT_VERSION:
-        raise ValueError(f"{path!r}: format_version {meta['format_version']} "
-                         f"is newer than supported {_FORMAT_VERSION}")
+    cls = _validate_meta(meta, path)
     fields = dict(meta.get("static", {}))
     for name, arr in arrays.items():
         fields[name] = jax.device_put(arr) if device else arr
-    index = registry[type_name](**fields)
+    index = cls(**fields)
     if meta.get("derived_present") and device and hasattr(index, "with_recon"):
         index = index.with_recon()  # rebuild the derived search tier
     return index
@@ -164,13 +170,7 @@ def load_index_checkpoint(path: Union[str, os.PathLike], *, shardings=None):
     path = os.path.abspath(os.fspath(path))
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
-    type_name = meta.get("index_type")
-    registry = _index_registry()
-    if type_name not in registry:
-        raise ValueError(f"{path!r}: unknown or missing index_type {type_name!r}")
-    if meta.get("format_version", 0) > _FORMAT_VERSION:
-        raise ValueError(f"{path!r}: format_version {meta['format_version']} "
-                         f"is newer than supported {_FORMAT_VERSION}")
+    cls = _validate_meta(meta, path)
     adir = os.path.join(path, "arrays")
     with ocp.StandardCheckpointer() as ckptr:
         if shardings:
@@ -212,7 +212,7 @@ def load_index_checkpoint(path: Union[str, os.PathLike], *, shardings=None):
     for name, arr in arrays.items():
         fields[name] = arr if isinstance(arr, jax.Array) \
             else jax.device_put(arr)
-    index = registry[type_name](**fields)
+    index = cls(**fields)
     if meta.get("derived_present") and hasattr(index, "with_recon"):
         index = index.with_recon()
     return index

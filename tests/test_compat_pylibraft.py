@@ -154,3 +154,62 @@ def test_interruptible_surface():
     with pytest.raises(interruptible.InterruptedException):
         interruptible.synchronize()
     interruptible.synchronize()  # flag auto-cleared on raise
+
+
+def test_neighbors_upstream_convention_end_to_end():
+    """The pre-cuVS pylibraft.neighbors flow: params-first build/search,
+    handle= accepted, refine composes."""
+    from raft_tpu.compat.pylibraft.neighbors import cagra, ivf_pq, refine
+
+    rng = np.random.default_rng(1)
+    x = (rng.standard_normal((600, 16)) +
+         4 * rng.standard_normal((20, 16))[rng.integers(0, 20, 600)]
+         ).astype(np.float32)
+    idx = ivf_pq.build(ivf_pq.IndexParams(n_lists=16, pq_dim=8), x, handle=object())
+    d, cand = ivf_pq.search(ivf_pq.SearchParams(n_probes=16), idx, x[:8], 20)
+    d2, found = refine(x, x[:8], cand, 5)
+    assert (np.asarray(found)[:, 0] == np.arange(8)).all()
+
+    g = cagra.build(cagra.IndexParams(intermediate_graph_degree=16,
+                                      graph_degree=8,
+                                      build_algo="nn_descent"), x)
+    _, gi = cagra.search(cagra.SearchParams(itopk_size=32, search_width=4),
+                         g, x[:8], 5)
+    assert (np.asarray(gi)[:, 0] == np.arange(8)).all()
+
+
+def test_neighbors_lut_dtype_selects_lut_tier():
+    from raft_tpu.compat.pylibraft.neighbors import ivf_pq
+
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((400, 16)).astype(np.float32)
+    idx = ivf_pq.build(ivf_pq.IndexParams(n_lists=8, pq_dim=8), x)
+    # fp8-style LUT request routes to the code-resident tier and still works
+    d, i = ivf_pq.search(ivf_pq.SearchParams(n_probes=8, lut_dtype="float16"),
+                         idx, x[:4], 3)
+    assert np.asarray(i).shape == (4, 3)
+
+
+def test_neighbors_add_data_on_build_false():
+    from raft_tpu.compat.pylibraft.neighbors import ivf_flat
+
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((300, 8)).astype(np.float32)
+    idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=8,
+                                              add_data_on_build=False), x)
+    assert int(np.asarray(idx.counts).sum()) == 0
+    idx = ivf_flat.extend(idx, x, np.arange(300))
+    d, i = ivf_flat.search(ivf_flat.SearchParams(n_probes=8), idx, x[:6], 1)
+    assert (np.asarray(i)[:, 0] == np.arange(6)).all()  # no duplicates
+
+
+def test_neighbors_out_params_filled():
+    from raft_tpu.compat.pylibraft.neighbors import brute_force
+
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((80, 8)).astype(np.float32)
+    iout = np.zeros((4, 3), np.int32)
+    dout = np.zeros((4, 3), np.float32)
+    d, i = brute_force.knn(x, x[:4], 3, iout, dout)
+    assert i is iout and d is dout
+    assert (iout[:, 0] == np.arange(4)).all()
