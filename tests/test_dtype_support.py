@@ -99,3 +99,14 @@ def test_knn_mixed_dtype_queries(int_data):
     from raft_tpu.stats import neighborhood_recall
 
     assert float(neighborhood_recall(np.asarray(i), np.asarray(i_ref))) >= 0.99
+
+
+def test_knn_sharded_uint8(int_data, mesh8):
+    db, q, sel = int_data
+    from raft_tpu.neighbors.brute_force import knn_sharded
+
+    db8 = db[:2960]  # divisible by 8
+    d, i = knn_sharded(q, db8, 5, mesh=mesh8)
+    _, i_ref = brute_force.knn(q.astype(np.float32),
+                               db8.astype(np.float32), 5)
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(i_ref))
