@@ -70,6 +70,10 @@ PQ_ROWS = int(os.environ.get("RAFT_BENCH_PQ_ROWS", 10_000_000))
 CAGRA_ROWS = int(os.environ.get("RAFT_BENCH_CAGRA_ROWS", 1_000_000))
 IF_ROWS = int(os.environ.get("RAFT_BENCH_IF_ROWS", 1_000_000))
 SKIP = set(filter(None, os.environ.get("RAFT_BENCH_SKIP", "").split(",")))
+# soft wall budget: the driver must always see the final JSON line, so we
+# stop STARTING north-star configs once the budget is spent (a config in
+# flight still finishes; the skipped ones are recorded as budget-skipped)
+BUDGET_S = float(os.environ.get("RAFT_BENCH_BUDGET_S", 2400))
 
 
 def _bench_brute_force():
@@ -251,6 +255,7 @@ def _bench_ivf_flat_kmeans(rows=None):
 
 def main() -> None:
     north_star = {}
+    t_start = time.time()
 
     try:
         qps, recall, profile = _bench_brute_force()
@@ -267,6 +272,11 @@ def main() -> None:
             ("ivf_flat_kmeans_1m", _bench_ivf_flat_kmeans, IF_ROWS, 100_000,
              "ivf_flat")):
         if short in SKIP:
+            continue
+        if time.time() - t_start > BUDGET_S:
+            north_star[name] = {"skipped": "budget",
+                                "elapsed_s": round(time.time() - t_start, 1)}
+            print(json.dumps({"config": name, **north_star[name]}))
             continue
         try:
             res = fn()
