@@ -15,16 +15,19 @@ echo "$(date) waiting for TPU..." >> "$LOG/driver.log"
 until probe; do sleep 120; done
 echo "$(date) TPU is back" >> "$LOG/driver.log"
 
-run_step() {  # name, command...
-  local name=$1; shift
+run_step() {  # name, command...  (bounded: a hung tunnel must not block
+  local name=$1; shift            #  the rest of the queue)
   [ -f "$LOG/$name.done" ] && return 0
   echo "$(date) start $name" >> "$LOG/driver.log"
-  if "$@" > "$LOG/$name.log" 2>&1; then
+  if timeout 3000 "$@" > "$LOG/$name.log" 2>&1; then
     touch "$LOG/$name.done"
     echo "$(date) done $name" >> "$LOG/driver.log"
   else
     rc=$?
     echo "$(date) FAILED $name (rc=$rc)" >> "$LOG/driver.log"
+    # a killed client can wedge the tunnel for every later step; re-probe
+    # before letting the queue continue
+    until probe; do sleep 120; done
   fi
 }
 
