@@ -12,7 +12,15 @@ mkdir -p "$LOG"
 probe() { timeout 120 python -c "import jax, jax.numpy as jnp; (jnp.ones((8,8)) @ jnp.ones((8,8))).sum().item()" >/dev/null 2>&1; }
 
 echo "$(date) waiting for TPU..." >> "$LOG/driver.log"
-until probe; do sleep 120; done
+# Long sleeps between probes: each failed probe kills a client mid-init,
+# which is itself the action that wedges the tunnel — aggressive polling
+# can prevent the server-side grant from ever clearing.  Give the relay a
+# quiet window, then test.
+SLEEP_S=${TPU_PROBE_SLEEP:-1800}
+until probe; do
+  echo "$(date) probe failed; quiet for ${SLEEP_S}s" >> "$LOG/driver.log"
+  sleep "$SLEEP_S"
+done
 echo "$(date) TPU is back" >> "$LOG/driver.log"
 
 run_step() {  # name, command...  (bounded: a hung tunnel must not block
