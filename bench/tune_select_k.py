@@ -30,15 +30,19 @@ from _timing import timeit as _time
 from raft_tpu.matrix.select_k import SelectAlgo, select_k
 
 GRID_ROWS = [256, 2048, 16384]
-GRID_COLS = [1024, 16384, 131072]
-GRID_K = [8, 32, 128]
+# 2048-wide / k=64 covers the brute-force fast path's shortlist cut
+# ((m, 2·bn) → cand); the rest spans the select_k bench shapes
+GRID_COLS = [1024, 2048, 16384, 131072]
+GRID_K = [8, 32, 64, 128]
 CANDIDATES = [SelectAlgo.kTopK, SelectAlgo.kPartialBitonic, SelectAlgo.kBinSelect]
 
 
 def main() -> None:
     quick = "--quick" in sys.argv
-    rows_grid = GRID_ROWS[:2] if quick else GRID_ROWS
-    cols_grid = GRID_COLS[:2] if quick else GRID_COLS
+    rows_grid = [256, 2048] if quick else GRID_ROWS
+    # quick mode keeps one short and one long column count (slicing the
+    # grid would silently drop the long-row buckets that matter most)
+    cols_grid = [1024, 16384] if quick else GRID_COLS
     table = {}
     key0 = jax.random.PRNGKey(0)
     for rows in rows_grid:

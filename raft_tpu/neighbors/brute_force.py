@@ -221,9 +221,13 @@ def _fast_knn_impl(x, y, k: int, metric: str, cand: int, bm: int, bn: int,
         # trades a sliver of recall for a cheaper (m, 2·bn)→cand cut.
         # The exact f32 rescore below keeps the *ranking* exact either way.
         neg, pos = jax.lax.approx_max_k(-sv, cand, recall_target=0.99)
+        sel_sv = -neg
     else:
-        neg, pos = jax.lax.top_k(-sv, cand)
-    sel_sv = -neg
+        # route through select_k so the offline-tuned dispatch table
+        # (which covers this (m, 2·bn, cand) bucket) picks the kernel
+        from ..matrix.select_k import select_k
+
+        sel_sv, pos = select_k(sv, cand, select_min=True)
     short = jnp.take_along_axis(si, pos, axis=1)
     dc = _exact_candidate_distances(x, y[short], metric)
     # shortlist slots that were never filled (inf sentinel, id clamped to 0)

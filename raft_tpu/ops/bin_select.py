@@ -38,24 +38,37 @@ def bin_select_k(
     x = x.astype(jnp.float32)
     batch, length = x.shape
 
-    lo = jnp.min(x, axis=1)            # (batch,)
-    hi = jnp.max(x, axis=1)
+    # Bounds from FINITE values only: masked/sentinel rows carry +inf
+    # (e.g. filtered search), and an inf hi would freeze width at inf so
+    # the rounds never tighten — the kernel would silently degrade to a
+    # full top_k with three wasted histogram passes.
+    finite = jnp.isfinite(x)
+    lo = jnp.min(jnp.where(finite, x, jnp.inf), axis=1)   # (batch,)
+    hi_f = jnp.max(jnp.where(finite, x, -jnp.inf), axis=1)
+    hi = jnp.where(jnp.isfinite(hi_f), hi_f, lo)
 
     def round_fn(_, carry):
         lo, hi = carry
         width = (hi - lo) / n_bins
         width = jnp.where(width > 0, width, 1.0)
-        # bin index of every element within current bounds, clamped
+        # bin index of every in-bounds element, clamped; out-of-bounds
+        # values (incl. +inf sentinels) are excluded from the histogram so
+        # bin counts are exact ranks within [lo, hi]
+        inb = x <= hi[:, None]
         b = jnp.clip(((x - lo[:, None]) / width[:, None]).astype(jnp.int32), 0, n_bins - 1)
         onehot = jax.nn.one_hot(b, n_bins, dtype=jnp.int32)          # (batch, len, B)
-        counts = jnp.sum(onehot, axis=1)                              # (batch, B)
+        counts = jnp.sum(onehot * inb[:, :, None], axis=1)            # (batch, B)
         cum = jnp.cumsum(counts, axis=1)
         # first bin where cumulative count reaches k
         target = jnp.argmax(cum >= k, axis=1)                         # (batch,)
         new_lo = lo + target.astype(jnp.float32) * width
         new_hi = lo + (target.astype(jnp.float32) + 1.0) * width
-        # keep invariant lo <= kth <= hi
-        return (jnp.maximum(lo, new_lo), jnp.minimum(hi, new_hi))
+        # tighten ONLY when the k-th value provably lies within [lo, hi]
+        # (fewer than k in-bounds entries means the k-th sits outside —
+        # e.g. < k finite values in a masked row)
+        found = cum[:, -1] >= k
+        return (jnp.where(found, jnp.maximum(lo, new_lo), lo),
+                jnp.where(found, jnp.minimum(hi, new_hi), hi))
 
     lo, hi = jax.lax.fori_loop(0, n_rounds, round_fn, (lo, hi))
 

@@ -183,3 +183,33 @@ def test_select_k_auto_correct_on_tuned_buckets():
         vals, idx = matrix.select_k(x, k)  # kAuto — exercises the reroute
         ref_vals, _ = select_k_reference(np.asarray(x), k)
         np.testing.assert_allclose(np.asarray(vals), ref_vals, rtol=1e-6)
+
+
+def test_bin_select_inf_sentinels_exact():
+    """+inf-masked rows (filtered search) must stay exact AND keep the
+    refinement effective: bounds come from finite values only."""
+    from raft_tpu.ops.bin_select import bin_select_k
+
+    rng = np.random.default_rng(9)
+    x = rng.standard_normal((16, 400)).astype(np.float32)
+    x[:, 150:] = np.inf            # most of every row masked out
+    x[3, :] = np.inf               # fully-masked row
+    x[5, :8] = np.inf              # fewer finite entries than k... almost
+    v, i = bin_select_k(jnp.asarray(x), 10)
+    v = np.asarray(v)
+    ref = np.sort(x, axis=1)[:, :10]
+    np.testing.assert_allclose(v, ref)
+    # returned indices must point at the returned values
+    got = np.take_along_axis(x, np.asarray(i), axis=1)
+    np.testing.assert_allclose(np.sort(got, axis=1), ref)
+
+
+def test_bin_select_fewer_finite_than_k():
+    from raft_tpu.ops.bin_select import bin_select_k
+
+    x = np.full((4, 64), np.inf, np.float32)
+    x[:, :3] = [[1, 2, 3]] * 4      # only 3 finite < k=8
+    v, i = bin_select_k(jnp.asarray(x), 8)
+    v = np.asarray(v)
+    np.testing.assert_allclose(np.sort(v, axis=1)[:, :3], [[1, 2, 3]] * 4)
+    assert np.isinf(np.sort(v, axis=1)[:, 3:]).all()
