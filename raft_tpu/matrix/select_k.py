@@ -84,7 +84,29 @@ def _tuned_entry(batch: int, length: int, k: int):
         return None
     # bucket by log2 like the reference's decision tree features
     key = f"{batch.bit_length()}:{length.bit_length()}:{k.bit_length()}"
-    return table.get(key)
+    hit = table.get(key)
+    if hit is not None:
+        return hit
+    # nearest-bucket fallback: the tuner measures a grid, but callers'
+    # shapes land between grid points (e.g. 10k rows → bucket 14, grid has
+    # 12/15).  Interpolate to the closest measured bucket — capped at one
+    # octave per axis so a wildly different shape still gets the default.
+    want = (batch.bit_length(), length.bit_length(), k.bit_length())
+    best_key, best_d = None, 4  # total log2 distance bound
+    for tk in table:
+        try:
+            tb, tl, tkk = (int(v) for v in tk.split(":"))
+        except ValueError:
+            continue
+        # one octave per axis, hard: extrapolating further (e.g. a batch
+        # 8x off the grid) must fall through to the default instead
+        if abs(tb - want[0]) > 1 or abs(tl - want[1]) > 1 \
+                or abs(tkk - want[2]) > 1:
+            continue
+        d = abs(tb - want[0]) + abs(tl - want[1]) + abs(tkk - want[2])
+        if d < best_d:
+            best_key, best_d = tk, d
+    return table.get(best_key) if best_key else None
 
 
 def select_k(

@@ -213,3 +213,23 @@ def test_bin_select_fewer_finite_than_k():
     v = np.asarray(v)
     np.testing.assert_allclose(np.sort(v, axis=1)[:, :3], [[1, 2, 3]] * 4)
     assert np.isinf(np.sort(v, axis=1)[:, 3:]).all()
+
+
+def test_select_k_tuned_nearest_bucket(monkeypatch):
+    """Shapes between tuner grid points interpolate to the closest
+    measured bucket instead of silently falling back to the default."""
+    import importlib
+
+    sk = importlib.import_module("raft_tpu.matrix.select_k")
+    table = {"12:11:4": "bin_select", "15:11:4": "partial_bitonic"}
+    monkeypatch.setattr(sk, "_tuned_table", lambda: table)
+    # exact hit
+    assert sk._tuned_entry(2048, 1024, 8) == "bin_select"
+    # rows 10000 -> bucket 14: nearest is 15 (distance 1)
+    assert sk._tuned_entry(10_000, 1024, 8) == "partial_bitonic"
+    # length four octaves away: no interpolation, default path
+    assert sk._tuned_entry(2048, 16384, 8) is None
+    # k far away: no interpolation
+    assert sk._tuned_entry(2048, 1024, 128) is None
+    # batch far off-grid (bucket 7 vs 12/15): must NOT extrapolate
+    assert sk._tuned_entry(64, 1024, 8) is None
