@@ -178,7 +178,10 @@ def _bench_cagra(rows=None):
     index = cagra.build(db, p)
     build_s = time.time() - t0
 
-    curve = sweep_cagra(index, q, gt, K, [(32, 4), (64, 4), (64, 8)])
+    # (128, 8) guards the recall-0.95 floor at 1M rows: the 100k-row
+    # quality table reads 0.966 at itopk=64, and recall drops with scale
+    curve = sweep_cagra(index, q, gt, K, [(32, 4), (64, 4), (64, 8),
+                                          (128, 8)])
     best = best_at_recall(curve, RECALL_FLOOR)
     return {"rows": n, "dim": d, "graph_degree": 32,
             "build_s": round(build_s, 1), "curve": curve,
