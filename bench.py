@@ -150,6 +150,10 @@ def _bench_ivf_pq(rows=None):
 
     curve = sweep_ivf_pq(index, q, gt, K, [4, 8, 16, 32],
                          refine_dataset=db_dev, refine_ratio=4)
+    if best_at_recall(curve, RECALL_FLOOR) is None:
+        # guard point, only when the cheap grid missed the recall floor
+        curve += sweep_ivf_pq(index, q, gt, K, [64],
+                              refine_dataset=db_dev, refine_ratio=4)
     best = best_at_recall(curve, RECALL_FLOOR)
     return {"rows": n, "dim": d, "n_lists": n_lists, "pq_dim": d // 2,
             "build_s": round(build_s, 1), "curve": curve,
@@ -178,10 +182,12 @@ def _bench_cagra(rows=None):
     index = cagra.build(db, p)
     build_s = time.time() - t0
 
-    # (128, 8) guards the recall-0.95 floor at 1M rows: the 100k-row
-    # quality table reads 0.966 at itopk=64, and recall drops with scale
-    curve = sweep_cagra(index, q, gt, K, [(32, 4), (64, 4), (64, 8),
-                                          (128, 8)])
+    curve = sweep_cagra(index, q, gt, K, [(32, 4), (64, 4), (64, 8)])
+    if best_at_recall(curve, RECALL_FLOOR) is None:
+        # (128, 8) guards the recall floor at 1M rows (the 100k quality
+        # table reads 0.966 at itopk=64 and recall drops with scale) —
+        # but only when the cheap grid missed, it is ~2.5x slower
+        curve += sweep_cagra(index, q, gt, K, [(128, 8)])
     best = best_at_recall(curve, RECALL_FLOOR)
     return {"rows": n, "dim": d, "graph_degree": 32,
             "build_s": round(build_s, 1), "curve": curve,
@@ -247,6 +253,8 @@ def _bench_ivf_flat_kmeans(rows=None):
                                                            seed=0))
     build_s = time.time() - t0
     curve = sweep_ivf_flat(index, q, gt, K, [1, 2, 4, 8, 16])
+    if best_at_recall(curve, RECALL_FLOOR) is None:
+        curve += sweep_ivf_flat(index, q, gt, K, [32])  # recall guard
     best = best_at_recall(curve, RECALL_FLOOR)
     return {"rows": n, "dim": d, "n_lists": n_lists,
             "kmeans_fit_s": round(fit_s, 1),
