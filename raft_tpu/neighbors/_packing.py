@@ -59,21 +59,36 @@ def prefetch_chunks(dataset, chunk_rows: int, ids=None):
             yield lo, hi, cur[0], cur[1]
 
 
-def as_keep_mask(filter, n=None):
-    """Normalize a prefilter (``core.Bitset`` or boolean array, True/1 =
-    keep) to a bool vector — the ``cuvs bitset_filter`` contract.  With
-    ``n`` the length is checked exactly (positional row numbering); IVF
-    callers instead validate against their max source id."""
+def as_keep_mask(filter, n=None, nq=None):
+    """Normalize a prefilter (True/1 = keep) to a bool mask — the cuVS
+    filter contract.  Accepts:
+
+    * ``core.Bitset`` or 1-D boolean array — one shared mask over source
+      rows (``bitset_filter``), returns ``(n,)``;
+    * ``core.Bitmap`` or 2-D boolean array — a PER-QUERY mask
+      (``bitmap_filter``), returns ``(nq, n)``.
+
+    With ``n`` the row count is checked exactly (positional numbering);
+    IVF callers instead validate against their max source id.  ``nq``
+    checks the query count of 2-D masks."""
     if filter is None:
         return None
-    from ..core.bitset import Bitset
+    from ..core.bitset import Bitmap, Bitset
     from ..core.errors import expects
 
-    keep = filter.to_bool_array() if isinstance(filter, Bitset) else \
-        jnp.asarray(filter, bool)
-    expects(keep.ndim == 1, "filter must be 1-D")
+    if isinstance(filter, Bitmap):
+        keep = filter.to_bool_array().reshape(filter.rows, filter.cols)
+    elif isinstance(filter, Bitset):
+        keep = filter.to_bool_array()
+    else:
+        keep = jnp.asarray(filter, bool)
+    expects(keep.ndim in (1, 2), "filter must be 1-D (bitset) or 2-D (bitmap)")
     if n is not None:
-        expects(keep.shape == (n,), f"filter covers {keep.shape}, need ({n},)")
+        expects(keep.shape[-1] == n,
+                f"filter covers {keep.shape[-1]} rows, need {n}")
+    if nq is not None and keep.ndim == 2:
+        expects(keep.shape[0] == nq,
+                f"bitmap filter has {keep.shape[0]} rows, need nq={nq}")
     return keep
 
 
@@ -126,18 +141,30 @@ def sharded_train_sizes(per: int, n_lists_local: int, trainset_fraction: float,
     return n_train, bal_cap
 
 
-def chunked_queries(run, q, chunk: int):
-    """Apply ``run(q_chunk) -> (vals, idx)`` over fixed-size query chunks
-    (pads the tail chunk so only one program is compiled); bounds the
-    per-dispatch gather working set of the IVF search paths."""
+def chunked_queries(run, q, chunk: int, aux=None):
+    """Apply ``run(q_chunk[, aux_chunk]) -> (vals, idx)`` over fixed-size
+    query chunks (pads the tail chunk so only one program is compiled);
+    bounds the per-dispatch gather working set of the IVF search paths.
+    ``aux``: optional per-query array (e.g. a bitmap filter's rows),
+    sliced in lockstep with the queries."""
     nq = q.shape[0]
+    call = (lambda qc, ac: run(qc)) if aux is None else run
     if chunk <= 0 or nq <= chunk:
-        return run(q)
+        return call(q, aux)
     pad = (-nq) % chunk
-    qp = jnp.concatenate([q, jnp.tile(q[:1], (pad, 1))], axis=0) if pad else q
+
+    def padded(a):
+        if not pad:
+            return a
+        return jnp.concatenate([a, jnp.tile(a[:1], (pad,) + (1,) * (a.ndim - 1))],
+                               axis=0)
+
+    qp = padded(q)
+    ap = padded(aux) if aux is not None else None
     vals, idxs = [], []
     for i in range(qp.shape[0] // chunk):
-        v, ix = run(qp[i * chunk:(i + 1) * chunk])
+        sl = slice(i * chunk, (i + 1) * chunk)
+        v, ix = call(qp[sl], None if ap is None else ap[sl])
         vals.append(v)
         idxs.append(ix)
     return (jnp.concatenate(vals, axis=0)[:nq],

@@ -89,8 +89,15 @@ def _knn_impl(x, y, k: int, metric: str, tile: int,
     if pad:
         y = jnp.concatenate([y, jnp.zeros((pad, d), y.dtype)], axis=0)
     ytiles = y.reshape(-1, tile, d)
-    if keep is not None:  # bitset/bool prefilter: False rows never rank
-        keep_t = jnp.pad(keep, (0, pad), constant_values=False).reshape(-1, tile)
+    keep_xs = None
+    if keep is not None:  # bitset/bool (n,) or per-query bitmap (m, n)
+        if keep.ndim == 1:
+            keep_t = jnp.pad(keep, (0, pad),
+                             constant_values=False).reshape(-1, tile)
+        else:  # (m, n) → scan xs of (n_tiles, m, tile) per-query tiles
+            keep_xs = jnp.moveaxis(
+                jnp.pad(keep, ((0, 0), (0, pad)), constant_values=False)
+                .reshape(m, -1, tile), 1, 0)
     xf = x.astype(jnp.float32)
     xn = jnp.sum(xf * xf, axis=1)
 
@@ -98,12 +105,12 @@ def _knn_impl(x, y, k: int, metric: str, tile: int,
 
     def step(carry, inp):
         best_val, best_idx = carry
-        t, yt = inp
+        t, yt, kt = inp
         dist = _tile_distances(x, yt, metric, xn)
         col = t * tile + jnp.arange(tile)
         valid = col[None, :] < n
         if keep is not None:
-            valid = valid & keep_t[t][None, :]
+            valid = valid & (keep_t[t][None, :] if kt is None else kt)
         dist = jnp.where(valid, dist, jnp.inf)
         neg, loc = jax.lax.top_k(-dist, kk)
         tv, ti = -neg, t * tile + loc
@@ -114,7 +121,8 @@ def _knn_impl(x, y, k: int, metric: str, tile: int,
         jnp.zeros((m, k), jnp.int32),
     )
     (bv, bi), _ = jax.lax.scan(
-        step, init, (jnp.arange(ytiles.shape[0], dtype=jnp.int32), ytiles)
+        step, init,
+        (jnp.arange(ytiles.shape[0], dtype=jnp.int32), ytiles, keep_xs),
     )
     if metric == "inner_product":
         bv = -bv  # undo the similarity negation
@@ -180,7 +188,11 @@ def _fast_knn_impl(x, y, k: int, metric: str, cand: int, bm: int, bn: int,
         # bf16-exact); also keeps fused_shortlist's dtype-equality contract
         xs, ys = xs.astype(jnp.float32), ys.astype(jnp.float32)
     if keep is not None:
-        yn = jnp.where(keep, yn, jnp.inf)
+        # 1-D masks ride the norm vector (zero extra cost); a per-query
+        # bitmap can only pre-drop rows NO query wants — the per-query
+        # part is applied exactly at the refine stage below
+        row_keep = keep if keep.ndim == 1 else jnp.any(keep, axis=0)
+        yn = jnp.where(row_keep, yn, jnp.inf)
 
     cand = min(cand, n)
     if jax.default_backend() == "tpu":
@@ -233,6 +245,10 @@ def _fast_knn_impl(x, y, k: int, metric: str, cand: int, bm: int, bn: int,
     # shortlist slots that were never filled (inf sentinel, id clamped to 0)
     # must not be re-scored into fake neighbors
     dc = jnp.where(jnp.isfinite(sel_sv), dc, jnp.inf)
+    if keep is not None and keep.ndim == 2:
+        # per-query bitmap: exact exclusion at the re-ranking stage
+        # (cand ≫ k, so dropped candidates rarely starve the top-k)
+        dc = jnp.where(jnp.take_along_axis(keep, short, axis=1), dc, jnp.inf)
     negv, p2 = jax.lax.top_k(-dc, k)
     vals = -negv
     if metric == "inner_product":
@@ -262,10 +278,15 @@ def knn(
     reduction — ``"exact"`` (lax.top_k) or ``"approx"``
     (``approx_max_k`` at recall_target 0.99, cheaper on TPU).
 
-    ``filter``: optional prefilter (``core.Bitset`` or (n,) bools, True =
-    keep) — filtered database rows never appear in results (cuVS
-    bitset-filtered search parity).  If fewer than k rows pass, the tail
-    carries id −1 with ±inf distance.
+    ``filter``: optional prefilter, True = keep (cuVS parity).  Either a
+    shared row mask (``core.Bitset`` / (n,) bools — ``bitset_filter``) or
+    a PER-QUERY mask (``core.Bitmap`` / (n_queries, n) bools —
+    ``bitmap_filter``, e.g. excluding each query's own document set).
+    Filtered rows never appear in results; if fewer than k rows pass, the
+    tail carries id −1 with ±inf distance.  In ``mode="fast"`` a bitmap's
+    per-query exclusions are applied exactly at the re-ranking stage (the
+    shortlist is shared across queries), so keep ``cand ≫`` the number of
+    per-query exclusions expected inside any query's shortlist.
     """
     x = wrap_array(queries, ndim=2, name="queries")
     y = wrap_array(database, ndim=2, name="database")
@@ -275,7 +296,7 @@ def knn(
     expects(mode in ("exact", "fast"), f"unknown mode {mode!r}")
     from ._packing import as_keep_mask, sentinel_filtered_ids
 
-    keep = as_keep_mask(filter, y.shape[0])
+    keep = as_keep_mask(filter, y.shape[0], nq=x.shape[0])
     expects(cut in ("exact", "approx"), f"unknown cut {cut!r}")
     if mode == "fast":
         vals, ids = _fast_knn_impl(x, y, int(k), metric, int(max(cand, k)),

@@ -265,7 +265,9 @@ def _probe_scan(q, qn, data, ids, counts, norms, probes, k: int, metric: str,
         valid = jnp.arange(cap)[None, :] < counts[lists][:, None]
         valid = valid & (vids >= 0)
         if keep is not None:
-            valid = valid & keep[jnp.maximum(vids, 0)]
+            vc = jnp.maximum(vids, 0)
+            valid = valid & (keep[vc] if keep.ndim == 1
+                             else jnp.take_along_axis(keep, vc, axis=1))
         dist = jnp.where(valid, dist, jnp.inf)
         return tile_knn_merge(best_val, best_idx, dist, vids, k), None
 
@@ -296,27 +298,33 @@ def search(index: IvfFlatIndex, queries, k: int,
            res=None) -> Tuple[jax.Array, jax.Array]:
     """Approximate kNN: returns ``(distances, ids)`` of (nq, k), best first.
 
-    ``filter``: optional prefilter by source id (``core.Bitset`` or bools
-    over the ORIGINAL row numbering, True = keep) — cuVS bitset-filtered
-    search parity."""
+    ``filter``: optional prefilter by source id over the ORIGINAL row
+    numbering, True = keep — a shared ``core.Bitset``/(n,) bools (cuVS
+    bitset filter) or a per-query ``core.Bitmap``/(nq, n) bools (bitmap
+    filter)."""
     from ._packing import as_keep_mask, chunked_queries, sentinel_filtered_ids
 
     p = params or IvfFlatSearchParams()
     q = wrap_array(queries, ndim=2, name="queries")
     expects(q.shape[1] == index.dim, "query dim mismatch")
     n_probes = min(p.n_probes, index.n_lists)
-    keep = as_keep_mask(filter)  # indexes source ids (may be custom)
+    keep = as_keep_mask(filter, nq=q.shape[0])  # indexes source ids
     if keep is not None:
         # must cover the largest stored id: the gather clamps OOB indices,
         # which would silently read an unrelated id's bit
-        expects(keep.shape[0] > int(jnp.max(index.ids)),
-                f"filter covers {keep.shape[0]} ids, index ids reach "
+        expects(keep.shape[-1] > int(jnp.max(index.ids)),
+                f"filter covers {keep.shape[-1]} ids, index ids reach "
                 f"{int(jnp.max(index.ids))}")
 
-    run = lambda qc: _search_impl(index.centroids, index.data, index.ids,
-                                  index.counts, index.norms, qc, int(k),
-                                  int(n_probes), index.metric, keep)
-    dv, di = chunked_queries(run, q, int(p.query_chunk))
+    impl = lambda qc, kc: _search_impl(
+        index.centroids, index.data, index.ids, index.counts,
+        index.norms, qc, int(k), int(n_probes), index.metric, kc)
+    if keep is not None and keep.ndim == 2:
+        # bitmap rows ride along with their query chunk
+        dv, di = chunked_queries(impl, q, int(p.query_chunk), aux=keep)
+    else:
+        dv, di = chunked_queries(lambda qc: impl(qc, keep), q,
+                                 int(p.query_chunk))
     if keep is not None:  # sub-k survivors: sentinel tail, not real ids
         di = sentinel_filtered_ids(dv, di)
     return dv, di

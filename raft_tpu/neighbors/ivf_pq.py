@@ -409,7 +409,10 @@ def _search_recon_impl(centroids, recon, recon_norms, ids, q,
             # recon_norms carries +inf on pad entries — they self-mask
             dist = qn[:, None] - 2.0 * dots + recon_norms[lists]
         if keep is not None:  # prefilter by source id (True = keep)
-            dist = jnp.where(keep[jnp.maximum(vids, 0)], dist, jnp.inf)
+            vc = jnp.maximum(vids, 0)
+            ok = keep[vc] if keep.ndim == 1 \
+                else jnp.take_along_axis(keep, vc, axis=1)
+            dist = jnp.where(ok, dist, jnp.inf)
         return tile_knn_merge(best_val, best_idx, dist, vids, k), None
 
     init = (jnp.full((nq, k), jnp.inf, jnp.float32),
@@ -473,7 +476,9 @@ def _search_lut_impl(centroids, codebooks, codes, code_norms, ids, counts, q,
         vids = ids[lists]
         valid = valid & (vids >= 0)
         if keep is not None:  # prefilter by source id (True = keep)
-            valid = valid & keep[jnp.maximum(vids, 0)]
+            vc = jnp.maximum(vids, 0)
+            valid = valid & (keep[vc] if keep.ndim == 1
+                             else jnp.take_along_axis(keep, vc, axis=1))
         dist = jnp.where(valid, dist, jnp.inf)
         return tile_knn_merge(best_val, best_idx, dist, vids, k), None
 
@@ -493,8 +498,9 @@ def search(index: IvfPqIndex, queries, k: int,
     """Approximate kNN over the PQ index; combine with
     :func:`raft_tpu.neighbors.refine.refine` for exact re-ranking.
 
-    ``filter``: optional prefilter by source id (``core.Bitset`` or bools,
-    True = keep) — cuVS bitset-filtered search parity."""
+    ``filter``: optional prefilter by source id, True = keep — a shared
+    ``core.Bitset``/(n,) bools or a per-query ``core.Bitmap``/(nq, n)
+    bools (cuVS bitset/bitmap filter parity)."""
     from ._packing import as_keep_mask, sentinel_filtered_ids
 
     p = params or IvfPqSearchParams()
@@ -502,29 +508,34 @@ def search(index: IvfPqIndex, queries, k: int,
     expects(q.shape[1] == index.dim, "query dim mismatch")
     expects(p.mode in ("auto", "recon", "lut"), f"unknown mode {p.mode!r}")
     n_probes = min(p.n_probes, index.n_lists)
-    keep = as_keep_mask(filter)  # indexes source ids (may be custom)
+    keep = as_keep_mask(filter, nq=q.shape[0])  # indexes source ids
     if keep is not None:
         # must cover the largest stored id: the gather clamps OOB indices,
         # which would silently read an unrelated id's bit
-        expects(keep.shape[0] > int(jnp.max(index.ids)),
-                f"filter covers {keep.shape[0]} ids, index ids reach "
+        expects(keep.shape[-1] > int(jnp.max(index.ids)),
+                f"filter covers {keep.shape[-1]} ids, index ids reach "
                 f"{int(jnp.max(index.ids))}")
     mode = p.mode
     if mode == "auto":
         mode = "recon" if index.recon is not None else "lut"
+    bitmap = keep is not None and keep.ndim == 2
     if mode == "recon":
         expects(index.recon is not None,
                 "mode='recon' needs the reconstruction slab — call "
                 "index.with_recon() (e.g. after load_index)")
-        run = lambda qc: _search_recon_impl(
+        impl = lambda qc, kc: _search_recon_impl(
             index.centroids, index.recon, index.recon_norms, index.ids,
-            qc, int(k), int(n_probes), index.metric, keep)
+            qc, int(k), int(n_probes), index.metric, kc)
     else:
-        run = lambda qc: _search_lut_impl(
+        impl = lambda qc, kc: _search_lut_impl(
             index.centroids, index.codebooks, index.codes, index.code_norms,
             index.ids, index.counts, qc, int(k), int(n_probes), index.metric,
-            keep)
-    dv, di = chunked_queries(run, q, int(p.query_chunk))
+            kc)
+    if bitmap:  # bitmap rows ride along with their query chunk
+        dv, di = chunked_queries(impl, q, int(p.query_chunk), aux=keep)
+    else:
+        dv, di = chunked_queries(lambda qc: impl(qc, keep), q,
+                                 int(p.query_chunk))
     if keep is not None:  # sub-k survivors: sentinel tail, not real ids
         di = sentinel_filtered_ids(dv, di)
     return dv, di

@@ -184,3 +184,75 @@ class TestCagraExtend:
         assert idx.size == 500  # source untouched
         d, i = cagra.search(idx, x[:8], 3, cagra.CagraSearchParams(itopk_size=16))
         assert np.asarray(i).shape == (8, 3)
+
+
+class TestBitmapFilter:
+    """Per-query (nq, n) bitmap filters — cuVS bitmap_filter parity."""
+
+    @pytest.fixture(scope="class")
+    def bdata(self):
+        rng = np.random.default_rng(17)
+        x = rng.standard_normal((2000, 16)).astype(np.float32)
+        q = x[:32]  # queries ARE rows: the classic "exclude self" setup
+        bitmap = np.ones((32, 2000), bool)
+        bitmap[np.arange(32), np.arange(32)] = False  # each excludes itself
+        _, gt_all = brute_force.knn(q, x, 2)
+        return x, q, bitmap, np.asarray(gt_all)
+
+    def test_exact_mode_excludes_self(self, bdata):
+        x, q, bitmap, gt_all = bdata
+        d, ids = brute_force.knn(q, x, 1, filter=bitmap)
+        ids = np.asarray(ids)
+        assert not (ids[:, 0] == np.arange(32)).any()
+        # the answer is exactly each query's second-nearest overall
+        np.testing.assert_array_equal(ids[:, 0], gt_all[:, 1])
+
+    def test_fast_mode_excludes_self(self, bdata):
+        x, q, bitmap, gt_all = bdata
+        _, ids = brute_force.knn(q, x, 1, mode="fast", cand=32, filter=bitmap)
+        ids = np.asarray(ids)
+        assert not (ids[:, 0] == np.arange(32)).any()
+        np.testing.assert_array_equal(ids[:, 0], gt_all[:, 1])
+
+    def test_core_bitmap_object(self, bdata):
+        from raft_tpu.core.bitset import Bitmap
+
+        x, q, bitmap, gt_all = bdata
+        bm = Bitmap(Bitset.from_bool_array(bitmap.reshape(-1)).words,
+                    *bitmap.shape)
+        _, ids = brute_force.knn(q, x, 1, filter=bm)
+        np.testing.assert_array_equal(np.asarray(ids)[:, 0], gt_all[:, 1])
+
+    def test_ivf_flat_bitmap(self, bdata):
+        x, q, bitmap, gt_all = bdata
+        idx = ivf_flat.build(x, ivf_flat.IvfFlatIndexParams(n_lists=8, seed=0))
+        _, ids = ivf_flat.search(idx, q, 1,
+                                 ivf_flat.IvfFlatSearchParams(n_probes=8),
+                                 filter=bitmap)
+        np.testing.assert_array_equal(np.asarray(ids)[:, 0], gt_all[:, 1])
+
+    def test_ivf_flat_bitmap_chunked(self, bdata):
+        x, q, bitmap, gt_all = bdata
+        idx = ivf_flat.build(x, ivf_flat.IvfFlatIndexParams(n_lists=8, seed=0))
+        _, ids = ivf_flat.search(
+            idx, q, 1,
+            ivf_flat.IvfFlatSearchParams(n_probes=8, query_chunk=10),
+            filter=bitmap)  # chunk size not dividing nq: aux slicing path
+        np.testing.assert_array_equal(np.asarray(ids)[:, 0], gt_all[:, 1])
+
+    def test_ivf_pq_bitmap_both_tiers(self, bdata):
+        x, q, bitmap, gt_all = bdata
+        idx = ivf_pq.build(x, ivf_pq.IvfPqIndexParams(n_lists=8, pq_dim=8,
+                                                      seed=0))
+        for mode in ("recon", "lut"):
+            _, ids = ivf_pq.search(
+                idx, q, 1, ivf_pq.IvfPqSearchParams(n_probes=8, mode=mode),
+                filter=bitmap)
+            assert not (np.asarray(ids)[:, 0] == np.arange(32)).any(), mode
+
+    def test_bitmap_query_count_checked(self, bdata):
+        from raft_tpu.core.errors import LogicError
+
+        x, q, bitmap, _ = bdata
+        with pytest.raises(LogicError, match="bitmap filter has 5"):
+            brute_force.knn(q, x, 1, filter=bitmap[:5])
