@@ -20,7 +20,8 @@ from typing import Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-__all__ = ["pack_lists", "chunked_queries", "scatter_append",
+__all__ = ["pack_lists", "chunked_queries", "chunked_filtered_queries",
+           "scatter_append",
            "scatter_append_copy", "shard_rows", "sharded_train_sizes",
            "as_keep_mask", "sentinel_filtered_ids", "prefetch_chunks"]
 
@@ -169,6 +170,15 @@ def chunked_queries(run, q, chunk: int, aux=None):
         idxs.append(ix)
     return (jnp.concatenate(vals, axis=0)[:nq],
             jnp.concatenate(idxs, axis=0)[:nq])
+
+
+def chunked_filtered_queries(impl, q, chunk: int, keep):
+    """``impl(q_chunk, keep_chunk)`` over query chunks with the filter
+    contract shared by the IVF searches: a 2-D (bitmap) ``keep`` is
+    sliced in lockstep with the queries; ``None``/1-D rides the closure."""
+    if keep is not None and keep.ndim == 2:
+        return chunked_queries(impl, q, chunk, aux=keep)
+    return chunked_queries(lambda qc: impl(qc, keep), q, chunk)
 
 
 @partial(jax.jit, static_argnames=("n_lists", "cap", "fills"))

@@ -302,7 +302,8 @@ def search(index: IvfFlatIndex, queries, k: int,
     numbering, True = keep — a shared ``core.Bitset``/(n,) bools (cuVS
     bitset filter) or a per-query ``core.Bitmap``/(nq, n) bools (bitmap
     filter)."""
-    from ._packing import as_keep_mask, chunked_queries, sentinel_filtered_ids
+    from ._packing import (as_keep_mask, chunked_filtered_queries,
+                           sentinel_filtered_ids)
 
     p = params or IvfFlatSearchParams()
     q = wrap_array(queries, ndim=2, name="queries")
@@ -319,12 +320,7 @@ def search(index: IvfFlatIndex, queries, k: int,
     impl = lambda qc, kc: _search_impl(
         index.centroids, index.data, index.ids, index.counts,
         index.norms, qc, int(k), int(n_probes), index.metric, kc)
-    if keep is not None and keep.ndim == 2:
-        # bitmap rows ride along with their query chunk
-        dv, di = chunked_queries(impl, q, int(p.query_chunk), aux=keep)
-    else:
-        dv, di = chunked_queries(lambda qc: impl(qc, keep), q,
-                                 int(p.query_chunk))
+    dv, di = chunked_filtered_queries(impl, q, int(p.query_chunk), keep)
     if keep is not None:  # sub-k survivors: sentinel tail, not real ids
         di = sentinel_filtered_ids(dv, di)
     return dv, di

@@ -41,7 +41,7 @@ from ..cluster.kmeans import KMeansParams, capped_assign, kmeans_balanced_fit
 from ..core.array import wrap_array
 from ..core.errors import expects
 from ..distance.pairwise import sq_l2
-from ._packing import chunked_queries, pack_lists
+from ._packing import chunked_filtered_queries, pack_lists
 from .brute_force import tile_knn_merge
 
 __all__ = [
@@ -71,6 +71,9 @@ class IvfPqIndexParams:
     # the r1 default of 2.0 (padding = wasted gather bandwidth at search)
     list_cap_ratio: float = 1.5
     store_recon: bool = True  # build the bf16 reconstruction slab
+    # 4-bit packing of the stored codes (requires pq_bits <= 4): halves
+    # code HBM/disk; the LUT tier unpacks per probed list post-gather
+    pack_codes: bool = False
     seed: int = 0
 
 
@@ -94,6 +97,10 @@ class IvfPqIndex:
     # Derived tier (never serialized; rebuilt from codes via with_recon()):
     recon: Optional[jax.Array] = None        # [L, cap, d] bf16 x̂ slab
     recon_norms: Optional[jax.Array] = None  # [L, cap] f32 ‖x̂‖², +inf pads
+    # 4-bit packed storage (pq_bits ≤ 4): codes hold TWO sub-codes per
+    # byte, [L, cap, ceil(m/2)] — half the HBM/disk of byte codes
+    packed: bool = dataclasses.field(default=False,
+                                     metadata=dict(static=True))
 
     # save_index skips these; load_index restores them via with_recon()
     _derived_fields = ("recon", "recon_norms")
@@ -108,7 +115,9 @@ class IvfPqIndex:
 
     @property
     def pq_dim(self) -> int:
-        return int(self.codes.shape[2])
+        # codebooks carry the logical m; codes.shape[2] is ceil(m/2) when
+        # the 4-bit packing is active
+        return int(self.codebooks.shape[0])
 
     @property
     def dim(self) -> int:
@@ -133,6 +142,28 @@ class IvfPqIndex:
         if self.recon is None:
             return self
         return dataclasses.replace(self, recon=None, recon_norms=None)
+
+    def with_packed_codes(self) -> "IvfPqIndex":
+        """4-bit packing: two sub-codes per byte (requires ``pq_bits ≤ 4``
+        at build).  Halves code HBM/disk; the LUT tier unpacks per probed
+        list after the gather (so gather traffic is halved too).
+        ``extend`` requires unpacked codes — round-trip via
+        :meth:`with_unpacked_codes`."""
+        if self.packed:
+            return self
+        from ..core.errors import expects
+
+        expects(int(jnp.max(self.codes)) < 16,
+                "with_packed_codes needs 4-bit codes (build with pq_bits<=4)")
+        return dataclasses.replace(self, codes=_pack_codes4(self.codes),
+                                   packed=True)
+
+    def with_unpacked_codes(self) -> "IvfPqIndex":
+        if not self.packed:
+            return self
+        return dataclasses.replace(
+            self, codes=_unpack_codes4(self.codes, self.pq_dim),
+            packed=False)
 
 
 def _split_subspaces(x, m: int):
@@ -202,7 +233,8 @@ def _decode_slab(codes, centroids, codebooks, ids):
     ~256-list block; pad entries (id < 0) get ‖x̂‖² = +inf so the L2
     search path masks them for free.
     """
-    L, cap, m = codes.shape
+    L, cap, mc = codes.shape
+    m = codebooks.shape[0]  # logical sub-code count (mc = ceil(m/2) packed)
     d = centroids.shape[1]
     block = max(1, min(L, max(1, (1 << 24) // max(cap * d, 1))))
     pad = (-L) % block
@@ -213,6 +245,8 @@ def _decode_slab(codes, centroids, codebooks, ids):
 
     def decode_block(args):
         cb_codes, cb_cent, cb_ids = args
+        if mc != m:  # 4-bit packed: unpack one block at a time
+            cb_codes = _unpack_codes4(cb_codes, m)
         g = codebooks[sub[None, None, :], cb_codes.astype(jnp.int32)]
         rec = (g.reshape(cb_codes.shape[0], cap, d).astype(jnp.float32)
                + cb_cent[:, None, :].astype(jnp.float32))
@@ -227,11 +261,28 @@ def _decode_slab(codes, centroids, codebooks, ids):
 
     rec, norms = jax.lax.map(
         decode_block,
-        (codes_p.reshape(-1, block, cap, m),
+        (codes_p.reshape(-1, block, cap, mc),
          cent_p.reshape(-1, block, d),
          ids_p.reshape(-1, block, cap)),
     )
     return (rec.reshape(-1, cap, d)[:L], norms.reshape(-1, cap)[:L])
+
+
+def _pack_codes4(codes: jax.Array) -> jax.Array:
+    """Pack 4-bit sub-codes pairwise: ``[..., m] → [..., ceil(m/2)]``
+    (even positions in the low nibble).  Values must be < 16."""
+    m = codes.shape[-1]
+    if m % 2:
+        codes = jnp.pad(codes, [(0, 0)] * (codes.ndim - 1) + [(0, 1)])
+    return (codes[..., 0::2] | (codes[..., 1::2] << 4)).astype(jnp.uint8)
+
+
+def _unpack_codes4(packed: jax.Array, m: int) -> jax.Array:
+    """Inverse of :func:`_pack_codes4` for a logical width ``m``."""
+    lo = packed & 0xF
+    hi = packed >> 4
+    inter = jnp.stack([lo, hi], axis=-1).reshape(*packed.shape[:-1], -1)
+    return inter[..., :m].astype(jnp.uint8)
 
 
 def build(dataset, params: Optional[IvfPqIndexParams] = None, *,
@@ -242,6 +293,8 @@ def build(dataset, params: Optional[IvfPqIndexParams] = None, *,
     m = p.pq_dim or max(1, d // 4)
     expects(d % m == 0, f"dim {d} must divide by pq_dim {m}")
     expects(4 <= p.pq_bits <= 8, "pq_bits must be in [4, 8]")
+    expects(not p.pack_codes or p.pq_bits <= 4,
+            "pack_codes requires pq_bits <= 4")
     c = 1 << p.pq_bits
     cap = max(1, int(np.ceil(p.list_cap_ratio * n / p.n_lists)))
 
@@ -272,7 +325,8 @@ def build(dataset, params: Optional[IvfPqIndexParams] = None, *,
 
     index = IvfPqIndex(centroids, codebooks, pk_codes, pk_norms, pk_ids,
                        counts, p.metric)
-    return index.with_recon() if p.store_recon else index
+    index = index.with_recon() if p.store_recon else index
+    return index.with_packed_codes() if p.pack_codes else index
 
 
 def extend(index: IvfPqIndex, new_vectors, new_ids=None) -> IvfPqIndex:
@@ -284,6 +338,9 @@ def extend(index: IvfPqIndex, new_vectors, new_ids=None) -> IvfPqIndex:
 
     x = wrap_array(new_vectors, ndim=2)
     expects(x.shape[1] == index.dim, "vector dim mismatch")
+    expects(not index.packed,
+            "extend needs unpacked codes: index.with_unpacked_codes() "
+            "first, then re-pack with with_packed_codes()")
     m = index.pq_dim
     L, cap = index.n_lists, index.list_cap
     ids = (jnp.asarray(new_ids, jnp.int32) if new_ids is not None
@@ -343,6 +400,8 @@ def build_chunked(dataset, params: Optional[IvfPqIndexParams] = None, *,
     m = p.pq_dim or max(1, d // 4)
     expects(d % m == 0, f"dim {d} must divide by pq_dim {m}")
     expects(4 <= p.pq_bits <= 8, "pq_bits must be in [4, 8]")
+    expects(not p.pack_codes or p.pq_bits <= 4,
+            "pack_codes requires pq_bits <= 4")
     c = 1 << p.pq_bits
     cap = max(1, int(np.ceil(p.list_cap_ratio * n / p.n_lists)))
 
@@ -377,7 +436,8 @@ def build_chunked(dataset, params: Optional[IvfPqIndexParams] = None, *,
 
     index = IvfPqIndex(centroids, codebooks, codes, cnorms, ids_slab,
                        counts, p.metric)
-    return index.with_recon() if p.store_recon else index
+    index = index.with_recon() if p.store_recon else index
+    return index.with_packed_codes() if p.pack_codes else index
 
 
 # ---------------------------------------------------------------------------
@@ -451,7 +511,10 @@ def _search_lut_impl(centroids, codebooks, codes, code_norms, ids, counts, q,
             "qms,mcs->qmc", qr_sub, codebooks,
             preferred_element_type=jnp.float32,
         )                                          # [nq, m, c] inner products
-        lcodes = codes[lists].astype(jnp.int32)    # [nq, cap, m]
+        lcodes = codes[lists]                      # [nq, cap, m or ceil(m/2)]
+        if lcodes.shape[-1] != m:                  # 4-bit packed storage:
+            lcodes = _unpack_codes4(lcodes, m)     # unpack AFTER the gather
+        lcodes = lcodes.astype(jnp.int32)
         # gather: ip[nq, cap] = Σ_m lut[q, m, code[q, cap, m]]
         ip = jnp.sum(
             jnp.take_along_axis(lut, lcodes.transpose(0, 2, 1), axis=2),
@@ -518,7 +581,6 @@ def search(index: IvfPqIndex, queries, k: int,
     mode = p.mode
     if mode == "auto":
         mode = "recon" if index.recon is not None else "lut"
-    bitmap = keep is not None and keep.ndim == 2
     if mode == "recon":
         expects(index.recon is not None,
                 "mode='recon' needs the reconstruction slab — call "
@@ -531,11 +593,7 @@ def search(index: IvfPqIndex, queries, k: int,
             index.centroids, index.codebooks, index.codes, index.code_norms,
             index.ids, index.counts, qc, int(k), int(n_probes), index.metric,
             kc)
-    if bitmap:  # bitmap rows ride along with their query chunk
-        dv, di = chunked_queries(impl, q, int(p.query_chunk), aux=keep)
-    else:
-        dv, di = chunked_queries(lambda qc: impl(qc, keep), q,
-                                 int(p.query_chunk))
+    dv, di = chunked_filtered_queries(impl, q, int(p.query_chunk), keep)
     if keep is not None:  # sub-k survivors: sentinel tail, not real ids
         di = sentinel_filtered_ids(dv, di)
     return dv, di
@@ -625,6 +683,8 @@ def build_sharded(dataset, mesh, params: Optional[IvfPqIndexParams] = None,
     m = p.pq_dim or max(1, d // 4)
     expects(d % m == 0, f"dim {d} must divide by pq_dim {m}")
     expects(4 <= p.pq_bits <= 8, "pq_bits must be in [4, 8]")
+    expects(not p.pack_codes or p.pq_bits <= 4,
+            "pack_codes requires pq_bits <= 4")
     cc = 1 << p.pq_bits
     n_dev = int(mesh.shape[axis])
     x_sh, n, per = shard_rows(dataset, mesh, axis)
@@ -648,11 +708,13 @@ def build_sharded(dataset, mesh, params: Optional[IvfPqIndexParams] = None,
     encode = _sharded_encode_program(
         mesh, axis, n, per, n_lists_local, cap, m, bool(p.store_recon))
     codes, cnorms, ids, counts, rec, rnorms = encode(x_sh, centroids, codebooks)
-    return IvfPqIndex(
+    index = IvfPqIndex(
         centroids, codebooks, codes, cnorms, ids, counts, p.metric,
         rec if p.store_recon else None,
         rnorms if p.store_recon else None,
     )
+    # packing is elementwise, so it preserves the per-shard layout
+    return index.with_packed_codes() if p.pack_codes else index
 
 
 @partial(jax.jit, static_argnames=("k", "n_probes", "metric", "axis", "mesh",

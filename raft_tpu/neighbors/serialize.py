@@ -24,7 +24,9 @@ from ..core.serialize import load_arrays, save_arrays
 __all__ = ["save_index", "load_index",
            "save_index_checkpoint", "load_index_checkpoint"]
 
-_FORMAT_VERSION = 1
+# 2: IvfPqIndex gained the `packed` static field (4-bit codes) —
+#    older readers must reject rather than misread packed codes
+_FORMAT_VERSION = 2
 
 
 def _index_registry():

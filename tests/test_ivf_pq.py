@@ -94,3 +94,61 @@ def test_ivf_pq_sharded_matches_single(rng, mesh8):
     # the owning list)
     assert (i[:, 0] == np.arange(24)).mean() > 0.9
     assert np.all(np.diff(d, axis=1) >= -1e-5)
+
+
+def test_packed_codes_roundtrip_and_search(rng):
+    """4-bit packed storage: half-size codes, identical LUT results."""
+    from raft_tpu.neighbors import ivf_pq
+
+    x = rng.standard_normal((1200, 16)).astype(np.float32)
+    p = ivf_pq.IvfPqIndexParams(n_lists=8, pq_dim=8, pq_bits=4, seed=0)
+    idx = ivf_pq.build(x, p)
+    packed = idx.with_packed_codes()
+    assert packed.codes.shape[-1] == 4 and packed.packed
+    assert packed.pq_dim == 8  # logical width preserved
+    sp = ivf_pq.IvfPqSearchParams(n_probes=8, mode="lut")
+    d1, i1 = ivf_pq.search(idx, x[:16], 5, sp)
+    d2, i2 = ivf_pq.search(packed, x[:16], 5, sp)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+    np.testing.assert_allclose(np.asarray(d1), np.asarray(d2))
+    # unpack restores the exact byte codes
+    back = packed.with_unpacked_codes()
+    np.testing.assert_array_equal(np.asarray(back.codes), np.asarray(idx.codes))
+
+
+def test_packed_codes_recon_and_build_param(rng):
+    from raft_tpu.neighbors import ivf_pq
+
+    x = rng.standard_normal((800, 16)).astype(np.float32)
+    idx = ivf_pq.build(x, ivf_pq.IvfPqIndexParams(
+        n_lists=8, pq_dim=8, pq_bits=4, pack_codes=True, seed=0))
+    assert idx.packed and idx.recon is not None
+    # recon tier rebuilt FROM packed codes must match byte-code decode
+    ref = ivf_pq.build(x, ivf_pq.IvfPqIndexParams(
+        n_lists=8, pq_dim=8, pq_bits=4, seed=0))
+    np.testing.assert_array_equal(
+        np.asarray(idx.without_recon().with_recon().recon_norms),
+        np.asarray(ref.recon_norms))
+    d, i = ivf_pq.search(idx, x[:8], 5)  # recon tier on a packed index
+    assert (np.asarray(i)[:, 0] == np.arange(8)).all()
+    with pytest.raises(Exception, match="unpacked"):
+        ivf_pq.extend(idx, x[:4])
+    with pytest.raises(Exception, match="pq_bits"):
+        ivf_pq.build(x, ivf_pq.IvfPqIndexParams(
+            n_lists=8, pq_dim=8, pq_bits=8, pack_codes=True))
+
+
+def test_packed_codes_serialize_roundtrip(rng, tmp_path):
+    from raft_tpu.neighbors import ivf_pq
+    from raft_tpu.neighbors.serialize import load_index, save_index
+
+    x = rng.standard_normal((600, 16)).astype(np.float32)
+    idx = ivf_pq.build(x, ivf_pq.IvfPqIndexParams(
+        n_lists=8, pq_dim=8, pq_bits=4, pack_codes=True, seed=0))
+    save_index(tmp_path / "pq4", idx)
+    idx2 = load_index(tmp_path / "pq4")
+    assert idx2.packed
+    sp = ivf_pq.IvfPqSearchParams(n_probes=8, mode="lut")
+    np.testing.assert_array_equal(
+        np.asarray(ivf_pq.search(idx, x[:8], 5, sp)[1]),
+        np.asarray(ivf_pq.search(idx2, x[:8], 5, sp)[1]))
