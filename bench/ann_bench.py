@@ -112,6 +112,9 @@ def main() -> None:
     ap.add_argument("--metric", default="sqeuclidean")
     ap.add_argument("--n-lists", type=int, default=0, help="0 → 2·sqrt(n) rounded")
     ap.add_argument("--pq-dim", type=int, default=0, help="0 → d/2")
+    ap.add_argument("--pq-bits", type=int, default=8, help="codebook bits (4..8)")
+    ap.add_argument("--pack-codes", action="store_true",
+                    help="4-bit packed code storage (requires --pq-bits<=4)")
     ap.add_argument("--refine", type=int, default=4, help="ivf_pq refine ratio (0 = off)")
     ap.add_argument("--graph-degree", type=int, default=32)
     ap.add_argument("--sweep", default=None,
@@ -188,6 +191,8 @@ def main() -> None:
         if args.index == "ivf_pq":
             p = mod.IvfPqIndexParams(n_lists=n_lists,
                                      pq_dim=args.pq_dim or d // 2,
+                                     pq_bits=args.pq_bits,
+                                     pack_codes=args.pack_codes,
                                      metric=args.metric)
         else:
             p = mod.IvfFlatIndexParams(n_lists=n_lists, metric=args.metric)

@@ -151,9 +151,8 @@ class IvfPqIndex:
         :meth:`with_unpacked_codes`."""
         if self.packed:
             return self
-        from ..core.errors import expects
-
-        expects(int(jnp.max(self.codes)) < 16,
+        # static precondition: codebook size 2^pq_bits bounds every code
+        expects(self.codebooks.shape[1] <= 16,
                 "with_packed_codes needs 4-bit codes (build with pq_bits<=4)")
         return dataclasses.replace(self, codes=_pack_codes4(self.codes),
                                    packed=True)

@@ -24,9 +24,15 @@ from ..core.serialize import load_arrays, save_arrays
 __all__ = ["save_index", "load_index",
            "save_index_checkpoint", "load_index_checkpoint"]
 
-# 2: IvfPqIndex gained the `packed` static field (4-bit codes) —
-#    older readers must reject rather than misread packed codes
+# Readers accept <= _FORMAT_VERSION.  Writers stamp the LOWEST version
+# that can faithfully represent the artifact (_artifact_version), so only
+# genuinely new-format artifacts (4-bit packed codes, v2) are rejected by
+# older readers — everything else stays interchangeable.
 _FORMAT_VERSION = 2
+
+
+def _artifact_version(index) -> int:
+    return 2 if getattr(index, "packed", False) else 1
 
 
 def _index_registry():
@@ -63,7 +69,7 @@ def save_index(path: Union[str, os.PathLike], index) -> None:
     arrays = {k: np.asarray(v) for k, v in arrays.items()}
     save_arrays(path, arrays, metadata={
         "index_type": cls.__name__,
-        "format_version": _FORMAT_VERSION,
+        "format_version": _artifact_version(index),
         "static": static,
         "derived_present": [f for f in derived
                             if getattr(index, f, None) is not None],
@@ -143,7 +149,7 @@ def save_index_checkpoint(path: Union[str, os.PathLike], index) -> None:
     with open(os.path.join(path, "meta.json"), "w") as f:
         json.dump({
             "index_type": cls.__name__,
-            "format_version": _FORMAT_VERSION,
+            "format_version": _artifact_version(index),
             "static": static,
             "derived_present": [g for g in derived
                                 if getattr(index, g, None) is not None],
