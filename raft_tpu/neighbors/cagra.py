@@ -339,7 +339,7 @@ def _dedup_by_id(vals, ids):
                                    "metric"))
 def _search_impl(dataset, graph, routers, router_nodes, q, key, k: int,
                  itopk: int, width: int, iters: int, n_seeds: int,
-                 metric: str):
+                 metric: str, keep=None):
     nq, d = q.shape
     n = dataset.shape[0]
     deg = graph.shape[1]
@@ -402,6 +402,13 @@ def _search_impl(dataset, graph, routers, router_nodes, q, key, k: int,
     (beam_val, beam_idx, _), _ = jax.lax.scan(
         step, (beam_val, beam_idx, explored), None, length=iters
     )
+    if keep is not None:
+        # result-stage filter: the descent may pass through filtered
+        # nodes, but they can never be returned (see search() docstring)
+        bc = jnp.maximum(beam_idx, 0)
+        ok = keep[bc] if keep.ndim == 1 \
+            else jnp.take_along_axis(keep, bc, axis=1)
+        beam_val = jnp.where(ok & (beam_idx >= 0), beam_val, jnp.inf)
     out_val, pos = select_k(beam_val, k, select_min=True)
     out_idx = jnp.take_along_axis(beam_idx, pos, axis=1)
     if metric == "euclidean":
@@ -543,17 +550,36 @@ def search_sharded(index: ShardedCagraIndex, queries, k: int,
 
 
 def search(index: CagraIndex, queries, k: int,
-           params: Optional[CagraSearchParams] = None, *, seed: int = 0,
-           res=None) -> Tuple[jax.Array, jax.Array]:
-    """Graph beam search: returns ``(distances, ids)`` of (nq, k)."""
+           params: Optional[CagraSearchParams] = None, *, filter=None,
+           seed: int = 0, res=None) -> Tuple[jax.Array, jax.Array]:
+    """Graph beam search: returns ``(distances, ids)`` of (nq, k).
+
+    ``filter``: optional prefilter, True = keep — shared
+    ``core.Bitset``/(n,) bools or per-query ``core.Bitmap``/(nq, n) bools
+    (cuVS filtered-CAGRA parity).  Graph-traversal semantics: the descent
+    may route THROUGH filtered nodes (removing them would fragment the
+    graph), but they never appear in results — filtering happens on the
+    final beam, so size ``itopk_size`` ≥ ``k`` + the number of filtered
+    nodes you expect near the query (raise it for dense filters).  Slots
+    with no surviving candidate report id −1 with ±inf distance (−inf for
+    ``inner_product``, which reports similarities) — ``id == -1`` is the
+    reliable emptiness signal.
+    """
+    from ._packing import as_keep_mask, sentinel_filtered_ids
+
     p = params or CagraSearchParams()
     q = wrap_array(queries, ndim=2, name="queries")
     expects(q.shape[1] == index.dim, "query dim mismatch")
+    keep = as_keep_mask(filter, n=index.size, nq=q.shape[0])
     itopk = max(p.itopk_size, k)
     iters = p.max_iterations or max(1, (itopk + p.search_width - 1)
                                     // p.search_width)
     key = jax.random.PRNGKey(seed)
-    return _search_impl(index.dataset, index.graph, index.router_centroids,
-                        index.router_nodes, q, key, int(k),
-                        int(itopk), int(p.search_width), int(iters),
-                        int(min(p.n_seeds, index.size)), index.metric)
+    dv, di = _search_impl(index.dataset, index.graph, index.router_centroids,
+                          index.router_nodes, q, key, int(k),
+                          int(itopk), int(p.search_width), int(iters),
+                          int(min(p.n_seeds, index.size)), index.metric,
+                          keep)
+    if keep is not None:
+        di = sentinel_filtered_ids(dv, di)
+    return dv, di

@@ -256,3 +256,60 @@ class TestBitmapFilter:
         x, q, bitmap, _ = bdata
         with pytest.raises(LogicError, match="bitmap filter has 5"):
             brute_force.knn(q, x, 1, filter=bitmap[:5])
+
+
+class TestCagraFilter:
+    @pytest.fixture(scope="class")
+    def cdata(self):
+        from raft_tpu.neighbors import cagra
+
+        rng = np.random.default_rng(23)
+        x = (rng.standard_normal((2000, 16)) +
+             4 * rng.standard_normal((30, 16))[rng.integers(0, 30, 2000)]
+             ).astype(np.float32)
+        idx = cagra.build(x, cagra.CagraIndexParams(
+            intermediate_graph_degree=24, graph_degree=12,
+            build_algo="brute_force", n_routers=32, seed=0))
+        return x, idx
+
+    def test_bitset_filter_excludes(self, cdata):
+        from raft_tpu.neighbors import cagra
+
+        x, idx = cdata
+        q = x[:24]
+        keep = np.ones(2000, bool)
+        keep[:500] = False
+        _, ids = cagra.search(idx, q, 5,
+                              cagra.CagraSearchParams(itopk_size=64),
+                              filter=keep)
+        ids = np.asarray(ids)
+        assert not ((ids >= 0) & (ids < 500)).any()
+        # recall vs exact filtered reference on surviving slots
+        sub = np.where(keep)[0]
+        _, gt_sub = brute_force.knn(q, x[sub], 5)
+        gt = sub[np.asarray(gt_sub)]
+        assert float(neighborhood_recall(ids, gt)) > 0.8
+
+    def test_bitmap_filter_excludes_self(self, cdata):
+        from raft_tpu.neighbors import cagra
+
+        x, idx = cdata
+        q = x[:24]
+        bitmap = np.ones((24, 2000), bool)
+        bitmap[np.arange(24), np.arange(24)] = False
+        _, ids = cagra.search(idx, q, 3,
+                              cagra.CagraSearchParams(itopk_size=32),
+                              filter=bitmap)
+        assert not (np.asarray(ids)[:, 0] == np.arange(24)).any()
+
+    def test_sub_k_survivors_sentinel(self, cdata):
+        from raft_tpu.neighbors import cagra
+
+        x, idx = cdata
+        keep = np.zeros(2000, bool)
+        keep[:2] = True  # fewer keepers than k
+        d, ids = cagra.search(idx, x[:4], 5,
+                              cagra.CagraSearchParams(itopk_size=64),
+                              filter=keep)
+        ids = np.asarray(ids)
+        assert ((ids == -1) | (ids < 2)).all()
