@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["pack_lists", "chunked_queries", "chunked_filtered_queries",
-           "scatter_append",
+           "check_filter_covers_ids", "scatter_append",
            "scatter_append_copy", "shard_rows", "sharded_train_sizes",
            "as_keep_mask", "sentinel_filtered_ids", "prefetch_chunks"]
 
@@ -91,6 +91,17 @@ def as_keep_mask(filter, n=None, nq=None):
         expects(keep.shape[0] == nq,
                 f"bitmap filter has {keep.shape[0]} rows, need nq={nq}")
     return keep
+
+
+def check_filter_covers_ids(keep, ids):
+    """Validate a keep mask covers every stored source id (the gather
+    clamps OOB indices, which would silently read an unrelated id's bit).
+    One device reduction, evaluated once."""
+    from ..core.errors import expects
+
+    max_id = int(jnp.max(ids))
+    expects(keep.shape[-1] > max_id,
+            f"filter covers {keep.shape[-1]} ids, index ids reach {max_id}")
 
 
 def sentinel_filtered_ids(vals, ids):

@@ -312,7 +312,8 @@ def knn(
 @functools.lru_cache(maxsize=64)
 def _sharded_knn_program(mesh: Mesh, axis: str, rows: int, k: int, kk: int,
                          metric: str, tile: int, merge: str,
-                         data_axis: Optional[str] = None):
+                         data_axis: Optional[str] = None,
+                         keep_ndim: int = 0):
     """Compile-once sharded search: jit keyed on the static config instead of
     a per-call closure (which would re-trace every knn_sharded call).
 
@@ -323,11 +324,12 @@ def _sharded_knn_program(mesh: Mesh, axis: str, rows: int, k: int, kk: int,
     slices; see ``core.mesh.make_hybrid_mesh``)."""
     nsh = mesh.shape[axis]
 
-    def local(xq, ysh):
-        # ysh: (1, rows, d) block of this shard
+    def local(xq, ysh, kp):
+        # ysh: (1, rows, d) block of this shard; kp: this shard's slice of
+        # the keep mask ((rows,) bitset / (m_local, rows) bitmap) or None
         ysh = ysh[0]
         shard = jax.lax.axis_index(axis)
-        v, i = _knn_impl(xq, ysh, kk, metric, tile)
+        v, i = _knn_impl(xq, ysh, kk, metric, tile, kp)
         if metric == "inner_product":
             v = -v  # back to smaller-is-nearer for the cross-shard merge
         gi = i + shard * rows
@@ -357,11 +359,16 @@ def _sharded_knn_program(mesh: Mesh, axis: str, rows: int, k: int, kk: int,
         return out_v, out_i
 
     qspec = P(data_axis) if data_axis else P()
+    # keep slices along the DATABASE axis: (n,) → P(axis); a (m, n) bitmap
+    # additionally follows the query partitioning on its rows
+    kspec = (P() if keep_ndim == 0
+             else P(axis) if keep_ndim == 1
+             else P(data_axis, axis))
     return jax.jit(
         jax.shard_map(
             local,
             mesh=mesh,
-            in_specs=(qspec, P(axis)),
+            in_specs=(qspec, P(axis), kspec),
             out_specs=(qspec, qspec),
             check_vma=False,
         )
@@ -379,6 +386,7 @@ def knn_sharded(
     metric: str = "sqeuclidean",
     tile: int = 8192,
     merge: str = "gather",
+    filter=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Database-sharded exact kNN over a mesh axis.
 
@@ -395,7 +403,12 @@ def knn_sharded(
     stay on the shard axis, nothing crosses the data axis — lay the data
     axis over DCN and the shard axis over ICI
     (:func:`raft_tpu.core.make_hybrid_mesh`).
+
+    ``filter``: bitset/bitmap prefilter, same contract as :func:`knn`
+    (masks slice along the database axis with the shards).
     """
+    from ._packing import as_keep_mask, sentinel_filtered_ids
+
     x = wrap_array(queries, ndim=2, name="queries")
     y = wrap_array(database, ndim=2, name="database")
     expects(merge in ("gather", "ring"), f"unknown merge {merge!r}")
@@ -409,9 +422,14 @@ def knn_sharded(
         nd = mesh.shape[data_axis]
         expects(x.shape[0] % nd == 0,
                 f"queries {x.shape[0]} not divisible by data axis {nd}")
+    keep = as_keep_mask(filter, n, nq=x.shape[0])
     rows = n // nsh
     kk = min(k, rows)
     fn = _sharded_knn_program(mesh, axis, rows, int(k), kk, metric,
-                              int(min(tile, rows)), merge, data_axis)
+                              int(min(tile, rows)), merge, data_axis,
+                              0 if keep is None else keep.ndim)
     yb = y.reshape(nsh, rows, y.shape[1])
-    return fn(x, yb)
+    dv, di = fn(x, yb, keep)
+    if keep is not None:
+        di = sentinel_filtered_ids(dv, di)
+    return dv, di

@@ -302,7 +302,8 @@ def search(index: IvfFlatIndex, queries, k: int,
     numbering, True = keep — a shared ``core.Bitset``/(n,) bools (cuVS
     bitset filter) or a per-query ``core.Bitmap``/(nq, n) bools (bitmap
     filter)."""
-    from ._packing import (as_keep_mask, chunked_filtered_queries,
+    from ._packing import (as_keep_mask, check_filter_covers_ids,
+                           chunked_filtered_queries,
                            sentinel_filtered_ids)
 
     p = params or IvfFlatSearchParams()
@@ -311,11 +312,7 @@ def search(index: IvfFlatIndex, queries, k: int,
     n_probes = min(p.n_probes, index.n_lists)
     keep = as_keep_mask(filter, nq=q.shape[0])  # indexes source ids
     if keep is not None:
-        # must cover the largest stored id: the gather clamps OOB indices,
-        # which would silently read an unrelated id's bit
-        expects(keep.shape[-1] > int(jnp.max(index.ids)),
-                f"filter covers {keep.shape[-1]} ids, index ids reach "
-                f"{int(jnp.max(index.ids))}")
+        check_filter_covers_ids(keep, index.ids)
 
     impl = lambda qc, kc: _search_impl(
         index.centroids, index.data, index.ids, index.counts,
@@ -397,10 +394,10 @@ def build_sharded(dataset, mesh: Mesh, params: Optional[IvfFlatIndexParams] = No
                                    "data_axis"))
 def _search_sharded_impl(mesh, axis, centroids, data, ids, counts, norms, q,
                          k: int, n_probes: int, metric: str,
-                         data_axis: Optional[str] = None):
-    def local(centroids_l, data_l, ids_l, counts_l, norms_l, q_l):
+                         data_axis: Optional[str] = None, keep=None):
+    def local(centroids_l, data_l, ids_l, counts_l, norms_l, q_l, keep_l):
         bv, bi = _search_impl(centroids_l, data_l, ids_l, counts_l, norms_l,
-                              q_l, k, n_probes, metric)
+                              q_l, k, n_probes, metric, keep_l)
         # candidates from all shards → final top-k everywhere
         if metric == "inner_product":
             bv = -bv  # back to min-selectable
@@ -416,19 +413,23 @@ def _search_sharded_impl(mesh, axis, centroids, data, ids, counts, norms, q,
         return fv, fi
 
     qspec = P(data_axis) if data_axis else P()
+    # keep masks GLOBAL source ids, so it rides replicated over the shard
+    # axis; a 2-D bitmap's query rows follow the query partitioning
+    kspec = (P(data_axis) if (keep is not None and keep.ndim == 2
+                              and data_axis) else P())
     return jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), qspec),
+        in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), qspec, kspec),
         out_specs=(qspec, qspec),
         check_vma=False,
-    )(centroids, data, ids, counts, norms, q)
+    )(centroids, data, ids, counts, norms, q, keep)
 
 
 def search_sharded(index: IvfFlatIndex, queries, k: int,
                    params: Optional[IvfFlatSearchParams] = None, *,
                    mesh: Mesh, axis: str = "shard",
-                   data_axis: Optional[str] = None
+                   data_axis: Optional[str] = None, filter=None
                    ) -> Tuple[jax.Array, jax.Array]:
     """Multi-chip search: each shard probes its local lists (n_probes per
     shard — recall ≥ single-chip at equal n_probes), one all_gather merges.
@@ -437,7 +438,13 @@ def search_sharded(index: IvfFlatIndex, queries, k: int,
     over shards always covers the globally nearest lists.  On a 2-D mesh,
     ``data_axis`` partitions the queries over that axis (merges stay on the
     shard axis — see :func:`raft_tpu.core.make_hybrid_mesh`).
+
+    ``filter``: bitset/bitmap prefilter over GLOBAL source ids, same
+    contract as :func:`search` (replicated over the shard axis).
     """
+    from ._packing import (as_keep_mask, check_filter_covers_ids,
+                           sentinel_filtered_ids)
+
     p = params or IvfFlatSearchParams()
     q = wrap_array(queries, ndim=2, name="queries")
     n_dev = int(mesh.shape[axis])
@@ -447,7 +454,13 @@ def search_sharded(index: IvfFlatIndex, queries, k: int,
         expects(data_axis in mesh.axis_names, f"axis {data_axis!r} not in mesh")
         expects(q.shape[0] % int(mesh.shape[data_axis]) == 0,
                 "queries not divisible by data axis")
-    return _search_sharded_impl(mesh, axis, index.centroids, index.data,
-                                index.ids, index.counts, index.norms, q,
-                                int(k), int(n_probes), index.metric,
-                                data_axis)
+    keep = as_keep_mask(filter, nq=q.shape[0])
+    if keep is not None:
+        check_filter_covers_ids(keep, index.ids)
+    dv, di = _search_sharded_impl(mesh, axis, index.centroids, index.data,
+                                  index.ids, index.counts, index.norms, q,
+                                  int(k), int(n_probes), index.metric,
+                                  data_axis, keep)
+    if keep is not None:
+        di = sentinel_filtered_ids(dv, di)
+    return dv, di

@@ -563,7 +563,8 @@ def search(index: IvfPqIndex, queries, k: int,
     ``filter``: optional prefilter by source id, True = keep — a shared
     ``core.Bitset``/(n,) bools or a per-query ``core.Bitmap``/(nq, n)
     bools (cuVS bitset/bitmap filter parity)."""
-    from ._packing import as_keep_mask, sentinel_filtered_ids
+    from ._packing import (as_keep_mask, check_filter_covers_ids,
+                           sentinel_filtered_ids)
 
     p = params or IvfPqSearchParams()
     q = wrap_array(queries, ndim=2, name="queries")
@@ -572,11 +573,7 @@ def search(index: IvfPqIndex, queries, k: int,
     n_probes = min(p.n_probes, index.n_lists)
     keep = as_keep_mask(filter, nq=q.shape[0])  # indexes source ids
     if keep is not None:
-        # must cover the largest stored id: the gather clamps OOB indices,
-        # which would silently read an unrelated id's bit
-        expects(keep.shape[-1] > int(jnp.max(index.ids)),
-                f"filter covers {keep.shape[-1]} ids, index ids reach "
-                f"{int(jnp.max(index.ids))}")
+        check_filter_covers_ids(keep, index.ids)
     mode = p.mode
     if mode == "auto":
         mode = "recon" if index.recon is not None else "lut"
@@ -721,7 +718,7 @@ def build_sharded(dataset, mesh, params: Optional[IvfPqIndexParams] = None,
 def _search_sharded_impl(mesh, axis, centroids, codebooks, codes, code_norms,
                          ids, counts, recon, recon_norms, q,
                          k: int, n_probes: int, metric: str, mode: str,
-                         data_axis: Optional[str] = None):
+                         data_axis: Optional[str] = None, keep=None):
     from jax.sharding import PartitionSpec as P
 
     def merge(bv, bi, nq_l):
@@ -739,41 +736,53 @@ def _search_sharded_impl(mesh, axis, centroids, codebooks, codes, code_norms,
         return fv, fi
 
     qspec = P(data_axis) if data_axis else P()
+    # keep masks GLOBAL source ids → replicated over the shard axis; a 2-D
+    # bitmap's query rows follow the query partitioning
+    kspec = (P(data_axis) if (keep is not None and keep.ndim == 2
+                              and data_axis) else P())
     if mode == "recon":
-        def local(centroids_l, recon_l, recon_norms_l, ids_l, q_l):
+        def local(centroids_l, recon_l, recon_norms_l, ids_l, q_l, keep_l):
             bv, bi = _search_recon_impl(centroids_l, recon_l, recon_norms_l,
-                                        ids_l, q_l, k, n_probes, metric)
+                                        ids_l, q_l, k, n_probes, metric,
+                                        keep_l)
             return merge(bv, bi, q_l.shape[0])
 
         return jax.shard_map(
             local, mesh=mesh,
-            in_specs=(P(axis), P(axis), P(axis), P(axis), qspec),
+            in_specs=(P(axis), P(axis), P(axis), P(axis), qspec, kspec),
             out_specs=(qspec, qspec), check_vma=False,
-        )(centroids, recon, recon_norms, ids, q)
+        )(centroids, recon, recon_norms, ids, q, keep)
 
     def local(centroids_l, codebooks_l, codes_l, code_norms_l, ids_l,
-              counts_l, q_l):
+              counts_l, q_l, keep_l):
         bv, bi = _search_lut_impl(centroids_l, codebooks_l, codes_l,
                                   code_norms_l, ids_l, counts_l, q_l,
-                                  k, n_probes, metric)
+                                  k, n_probes, metric, keep_l)
         return merge(bv, bi, q_l.shape[0])
 
     return jax.shard_map(
         local, mesh=mesh,
-        in_specs=(P(axis), P(), P(axis), P(axis), P(axis), P(axis), qspec),
+        in_specs=(P(axis), P(), P(axis), P(axis), P(axis), P(axis), qspec,
+                  kspec),
         out_specs=(qspec, qspec), check_vma=False,
-    )(centroids, codebooks, codes, code_norms, ids, counts, q)
+    )(centroids, codebooks, codes, code_norms, ids, counts, q, keep)
 
 
 def search_sharded(index: IvfPqIndex, queries, k: int,
                    params: Optional[IvfPqSearchParams] = None, *,
                    mesh, axis: str = "shard",
-                   data_axis: Optional[str] = None
+                   data_axis: Optional[str] = None, filter=None
                    ) -> Tuple[jax.Array, jax.Array]:
     """Multi-chip search: each shard probes its ``n_probes`` nearest
     *local* lists (union over shards covers the globally nearest lists),
     one all_gather of (nq, k) candidates merges over ICI.  On a 2-D mesh,
-    ``data_axis`` partitions the queries over that axis."""
+    ``data_axis`` partitions the queries over that axis.
+
+    ``filter``: bitset/bitmap prefilter over GLOBAL source ids, same
+    contract as :func:`search` (replicated over the shard axis)."""
+    from ._packing import (as_keep_mask, check_filter_covers_ids,
+                           sentinel_filtered_ids)
+
     p = params or IvfPqSearchParams()
     q = wrap_array(queries, ndim=2, name="queries")
     expects(q.shape[1] == index.dim, "query dim mismatch")
@@ -785,6 +794,9 @@ def search_sharded(index: IvfPqIndex, queries, k: int,
         expects(data_axis in mesh.axis_names, f"axis {data_axis!r} not in mesh")
         expects(q.shape[0] % int(mesh.shape[data_axis]) == 0,
                 "queries not divisible by data axis")
+    keep = as_keep_mask(filter, nq=q.shape[0])
+    if keep is not None:
+        check_filter_covers_ids(keep, index.ids)
     mode = p.mode
     if mode == "auto":
         mode = "recon" if index.recon is not None else "lut"
@@ -792,8 +804,12 @@ def search_sharded(index: IvfPqIndex, queries, k: int,
         expects(index.recon is not None,
                 "mode='recon' needs the reconstruction slab — call "
                 "index.with_recon() (e.g. after load_index)")
-    return _search_sharded_impl(mesh, axis, index.centroids, index.codebooks,
-                                index.codes, index.code_norms, index.ids,
-                                index.counts, index.recon, index.recon_norms,
-                                q, int(k), int(n_probes), index.metric, mode,
-                                data_axis)
+    dv, di = _search_sharded_impl(mesh, axis, index.centroids,
+                                  index.codebooks, index.codes,
+                                  index.code_norms, index.ids, index.counts,
+                                  index.recon, index.recon_norms,
+                                  q, int(k), int(n_probes), index.metric,
+                                  mode, data_axis, keep)
+    if keep is not None:
+        di = sentinel_filtered_ids(dv, di)
+    return dv, di

@@ -313,3 +313,70 @@ class TestCagraFilter:
                               filter=keep)
         ids = np.asarray(ids)
         assert ((ids == -1) | (ids < 2)).all()
+
+
+class TestShardedFilter:
+    """filter= on the sharded search paths (masks slice with the shards)."""
+
+    def test_knn_sharded_bitset_and_bitmap(self, mesh8):
+        from raft_tpu.neighbors.brute_force import knn, knn_sharded
+
+        rng = np.random.default_rng(29)
+        y = rng.standard_normal((1600, 16)).astype(np.float32)
+        q = y[:16]
+        keep = rng.random(1600) < 0.5
+        _, ref = knn(q, y, 5, filter=keep)
+        _, ids = knn_sharded(q, y, 5, mesh=mesh8, filter=keep)
+        np.testing.assert_array_equal(np.asarray(ids), np.asarray(ref))
+
+        bm = np.ones((16, 1600), bool)
+        bm[np.arange(16), np.arange(16)] = False
+        _, ids2 = knn_sharded(q, y, 1, mesh=mesh8, filter=bm)
+        assert not (np.asarray(ids2)[:, 0] == np.arange(16)).any()
+
+    def test_ivf_sharded_filters(self, mesh8):
+        from raft_tpu.neighbors import ivf_flat, ivf_pq
+
+        rng = np.random.default_rng(31)
+        x = rng.standard_normal((1600, 16)).astype(np.float32)
+        q = x[:8]
+        keep = np.ones(1600, bool)
+        keep[:8] = False  # the query rows themselves
+
+        fidx = ivf_flat.build_sharded(x, mesh8, ivf_flat.IvfFlatIndexParams(
+            n_lists=32, kmeans_n_iters=4))
+        _, ids = ivf_flat.search_sharded(
+            fidx, q, 3, ivf_flat.IvfFlatSearchParams(n_probes=4),
+            mesh=mesh8, filter=keep)
+        assert not ((np.asarray(ids) >= 0) & (np.asarray(ids) < 8)).any()
+
+        pidx = ivf_pq.build_sharded(x, mesh8, ivf_pq.IvfPqIndexParams(
+            n_lists=16, pq_dim=8, kmeans_n_iters=4, pq_kmeans_n_iters=4))
+        bm = np.ones((8, 1600), bool)
+        bm[np.arange(8), np.arange(8)] = False
+        _, ids2 = ivf_pq.search_sharded(
+            pidx, q, 1, ivf_pq.IvfPqSearchParams(n_probes=4),
+            mesh=mesh8, filter=bm)
+        assert not (np.asarray(ids2)[:, 0] == np.arange(8)).any()
+
+    def test_hybrid_mesh_bitmap_specs(self, mesh2x4):
+        """2-D mesh: bitmap rows follow the data axis, cols the shard axis
+        (the P(data_axis, axis) / P(data_axis) spec branches)."""
+        from raft_tpu.neighbors import ivf_flat
+        from raft_tpu.neighbors.brute_force import knn_sharded
+
+        rng = np.random.default_rng(37)
+        y = rng.standard_normal((1600, 16)).astype(np.float32)
+        q = y[:16]
+        bm = np.ones((16, 1600), bool)
+        bm[np.arange(16), np.arange(16)] = False
+        _, ids = knn_sharded(q, y, 1, mesh=mesh2x4, axis="shard",
+                             data_axis="data", filter=bm)
+        assert not (np.asarray(ids)[:, 0] == np.arange(16)).any()
+
+        fidx = ivf_flat.build_sharded(y, mesh2x4, ivf_flat.IvfFlatIndexParams(
+            n_lists=16, kmeans_n_iters=4))
+        _, ids2 = ivf_flat.search_sharded(
+            fidx, q, 1, ivf_flat.IvfFlatSearchParams(n_probes=4),
+            mesh=mesh2x4, data_axis="data", filter=bm)
+        assert not (np.asarray(ids2)[:, 0] == np.arange(16)).any()
