@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["pack_lists", "chunked_queries", "chunked_filtered_queries",
-           "check_filter_covers_ids", "scatter_append",
+           "check_filter_covers_ids", "keep_lookup", "scatter_append",
            "scatter_append_copy", "shard_rows", "sharded_train_sizes",
            "as_keep_mask", "sentinel_filtered_ids", "prefetch_chunks"]
 
@@ -93,15 +93,46 @@ def as_keep_mask(filter, n=None, nq=None):
     return keep
 
 
+_max_id_cache: dict = {}
+
+
+def _max_source_id(ids) -> int:
+    """max(ids) with a per-array cache: it is a build-time constant, and
+    recomputing it would put a device reduction + host sync on every
+    filtered search dispatch.  Keyed by id() with a weakref guard, so a
+    recycled id() can never return a stale value."""
+    import weakref
+
+    key = id(ids)
+    hit = _max_id_cache.get(key)
+    if hit is not None and hit[0]() is ids:
+        return hit[1]
+    val = int(jnp.max(ids))
+    if len(_max_id_cache) > 256:  # drop dead entries, bound growth
+        for k in [k for k, (r, _) in _max_id_cache.items() if r() is None]:
+            del _max_id_cache[k]
+    _max_id_cache[key] = (weakref.ref(ids), val)
+    return val
+
+
 def check_filter_covers_ids(keep, ids):
     """Validate a keep mask covers every stored source id (the gather
-    clamps OOB indices, which would silently read an unrelated id's bit).
-    One device reduction, evaluated once."""
+    clamps OOB indices, which would silently read an unrelated id's
+    bit)."""
     from ..core.errors import expects
 
-    max_id = int(jnp.max(ids))
+    max_id = _max_source_id(ids)
     expects(keep.shape[-1] > max_id,
             f"filter covers {keep.shape[-1]} ids, index ids reach {max_id}")
+
+
+def keep_lookup(keep, vids):
+    """Gather the keep bit for a (possibly −1-padded) id matrix — the one
+    id-indexed filter gather every search path shares.  OOB/pad ids are
+    clamped; callers mask validity separately."""
+    vc = jnp.maximum(vids, 0)
+    return keep[vc] if keep.ndim == 1 \
+        else jnp.take_along_axis(keep, vc, axis=1)
 
 
 def sentinel_filtered_ids(vals, ids):

@@ -405,10 +405,10 @@ def _search_impl(dataset, graph, routers, router_nodes, q, key, k: int,
     if keep is not None:
         # result-stage filter: the descent may pass through filtered
         # nodes, but they can never be returned (see search() docstring)
-        bc = jnp.maximum(beam_idx, 0)
-        ok = keep[bc] if keep.ndim == 1 \
-            else jnp.take_along_axis(keep, bc, axis=1)
-        beam_val = jnp.where(ok & (beam_idx >= 0), beam_val, jnp.inf)
+        from ._packing import keep_lookup
+
+        beam_val = jnp.where(keep_lookup(keep, beam_idx) & (beam_idx >= 0),
+                             beam_val, jnp.inf)
     out_val, pos = select_k(beam_val, k, select_min=True)
     out_idx = jnp.take_along_axis(beam_idx, pos, axis=1)
     if metric == "euclidean":
@@ -492,12 +492,12 @@ class ShardedCagraIndex:
 def _sharded_search_program(mesh: Mesh, axis: str, data_axis: Optional[str],
                             k: int, itopk: int, width: int, iters: int,
                             n_seeds: int, metric: str, per: int,
-                            n_rows: int):
+                            n_rows: int, keep_ndim: int = 0):
     """Compile-once sharded search (jit keyed on the static config — a
     per-call closure would re-trace every ``search_sharded`` call, which
     dominates pipelined QPS measurements)."""
 
-    def local(ds, g, rc, rn, q_l, key):
+    def local(ds, g, rc, rn, q_l, key, keep_l):
         bv, bi = _search_impl(ds[0], g[0], rc[0], rn[0], q_l, key, k,
                               itopk, width, iters, n_seeds, metric)
         shard = jax.lax.axis_index(axis)
@@ -505,6 +505,11 @@ def _sharded_search_program(mesh: Mesh, axis: str, data_axis: Optional[str],
         if metric == "inner_product":
             bv = -bv  # back to min-selectable before masking
         bv = jnp.where((bi >= 0) & (bi < n_rows), bv, jnp.inf)
+        if keep_l is not None:
+            # result-stage filter by GLOBAL source id (see search())
+            from ._packing import keep_lookup
+
+            bv = jnp.where(keep_lookup(keep_l, bi), bv, jnp.inf)
         av = jax.lax.all_gather(bv, axis)
         ai = jax.lax.all_gather(bi, axis)
         av = jnp.moveaxis(av, 0, 1).reshape(q_l.shape[0], -1)
@@ -515,9 +520,12 @@ def _sharded_search_program(mesh: Mesh, axis: str, data_axis: Optional[str],
         return fv, fi
 
     qspec = P(data_axis) if data_axis else P()
+    # keep masks GLOBAL ids → replicated over the shard axis; bitmap rows
+    # follow the query partitioning
+    kspec = (P(data_axis) if (keep_ndim == 2 and data_axis) else P())
     return jax.jit(jax.shard_map(
         local, mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis), P(axis), qspec, P()),
+        in_specs=(P(axis), P(axis), P(axis), P(axis), qspec, P(), kspec),
         out_specs=(qspec, qspec),
         check_vma=False,
     ))
@@ -526,11 +534,17 @@ def _sharded_search_program(mesh: Mesh, axis: str, data_axis: Optional[str],
 def search_sharded(index: ShardedCagraIndex, queries, k: int,
                    params: Optional[CagraSearchParams] = None, *,
                    mesh: Mesh, axis: str = "shard",
-                   data_axis: Optional[str] = None, seed: int = 0
+                   data_axis: Optional[str] = None, filter=None,
+                   seed: int = 0
                    ) -> Tuple[jax.Array, jax.Array]:
     """Every shard searches its sub-graph with the same program; one
     all_gather + select_k merges the per-shard top-k (ids globalized).
-    On a 2-D mesh, ``data_axis`` partitions the queries over that axis."""
+    On a 2-D mesh, ``data_axis`` partitions the queries over that axis.
+
+    ``filter``: bitset/bitmap over GLOBAL row numbering, result-stage
+    semantics as in :func:`search`."""
+    from ._packing import as_keep_mask, sentinel_filtered_ids
+
     p = params or CagraSearchParams()
     q = wrap_array(queries, ndim=2, name="queries")
     if data_axis is not None:
@@ -541,12 +555,16 @@ def search_sharded(index: ShardedCagraIndex, queries, k: int,
     iters = p.max_iterations or max(1, (itopk + p.search_width - 1)
                                     // p.search_width)
     per = int(index.datasets.shape[1])
+    keep = as_keep_mask(filter, n=int(index.n_rows), nq=q.shape[0])
     prog = _sharded_search_program(
         mesh, axis, data_axis, int(k), int(itopk), int(p.search_width),
         int(iters), int(min(p.n_seeds, per)), index.metric, per,
-        int(index.n_rows))
-    return prog(index.datasets, index.graphs, index.router_centroids,
-                index.router_nodes, q, jax.random.PRNGKey(seed))
+        int(index.n_rows), 0 if keep is None else keep.ndim)
+    dv, di = prog(index.datasets, index.graphs, index.router_centroids,
+                  index.router_nodes, q, jax.random.PRNGKey(seed), keep)
+    if keep is not None:
+        di = sentinel_filtered_ids(dv, di)
+    return dv, di
 
 
 def search(index: CagraIndex, queries, k: int,

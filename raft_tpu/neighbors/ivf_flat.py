@@ -265,9 +265,9 @@ def _probe_scan(q, qn, data, ids, counts, norms, probes, k: int, metric: str,
         valid = jnp.arange(cap)[None, :] < counts[lists][:, None]
         valid = valid & (vids >= 0)
         if keep is not None:
-            vc = jnp.maximum(vids, 0)
-            valid = valid & (keep[vc] if keep.ndim == 1
-                             else jnp.take_along_axis(keep, vc, axis=1))
+            from ._packing import keep_lookup
+
+            valid = valid & keep_lookup(keep, vids)
         dist = jnp.where(valid, dist, jnp.inf)
         return tile_knn_merge(best_val, best_idx, dist, vids, k), None
 

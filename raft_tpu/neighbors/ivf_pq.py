@@ -468,10 +468,9 @@ def _search_recon_impl(centroids, recon, recon_norms, ids, q,
             # recon_norms carries +inf on pad entries — they self-mask
             dist = qn[:, None] - 2.0 * dots + recon_norms[lists]
         if keep is not None:  # prefilter by source id (True = keep)
-            vc = jnp.maximum(vids, 0)
-            ok = keep[vc] if keep.ndim == 1 \
-                else jnp.take_along_axis(keep, vc, axis=1)
-            dist = jnp.where(ok, dist, jnp.inf)
+            from ._packing import keep_lookup
+
+            dist = jnp.where(keep_lookup(keep, vids), dist, jnp.inf)
         return tile_knn_merge(best_val, best_idx, dist, vids, k), None
 
     init = (jnp.full((nq, k), jnp.inf, jnp.float32),
@@ -538,9 +537,9 @@ def _search_lut_impl(centroids, codebooks, codes, code_norms, ids, counts, q,
         vids = ids[lists]
         valid = valid & (vids >= 0)
         if keep is not None:  # prefilter by source id (True = keep)
-            vc = jnp.maximum(vids, 0)
-            valid = valid & (keep[vc] if keep.ndim == 1
-                             else jnp.take_along_axis(keep, vc, axis=1))
+            from ._packing import keep_lookup
+
+            valid = valid & keep_lookup(keep, vids)
         dist = jnp.where(valid, dist, jnp.inf)
         return tile_knn_merge(best_val, best_idx, dist, vids, k), None
 

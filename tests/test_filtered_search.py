@@ -380,3 +380,25 @@ class TestShardedFilter:
             fidx, q, 1, ivf_flat.IvfFlatSearchParams(n_probes=4),
             mesh=mesh2x4, data_axis="data", filter=bm)
         assert not (np.asarray(ids2)[:, 0] == np.arange(16)).any()
+
+    def test_cagra_sharded_filter(self, mesh8):
+        from raft_tpu.neighbors import cagra
+
+        rng = np.random.default_rng(41)
+        x = rng.standard_normal((1600, 16)).astype(np.float32)
+        idx = cagra.build_sharded(x, mesh8, cagra.CagraIndexParams(
+            intermediate_graph_degree=16, graph_degree=8, n_routers=16))
+        q = x[:8]
+        bm = np.ones((8, 1600), bool)
+        bm[np.arange(8), np.arange(8)] = False
+        _, ids = cagra.search_sharded(
+            idx, q, 1, cagra.CagraSearchParams(itopk_size=16),
+            mesh=mesh8, filter=bm)
+        assert not (np.asarray(ids)[:, 0] == np.arange(8)).any()
+        keep = np.ones(1600, bool)
+        keep[:8] = False
+        _, ids2 = cagra.search_sharded(
+            idx, q, 3, cagra.CagraSearchParams(itopk_size=16),
+            mesh=mesh8, filter=keep)
+        ids2 = np.asarray(ids2)
+        assert not ((ids2 >= 0) & (ids2 < 8)).any()
