@@ -68,25 +68,40 @@ def _assign(x, centroids, tile: int = 4096):
     return i, d
 
 
-def _update(x, labels, k: int):
-    sums = jax.ops.segment_sum(x.astype(jnp.float32), labels, num_segments=k)
-    counts = jax.ops.segment_sum(jnp.ones((x.shape[0],), jnp.float32), labels, num_segments=k)
+def _update(x, labels, k: int, w=None):
+    xf = x.astype(jnp.float32)
+    if w is None:
+        sums = jax.ops.segment_sum(xf, labels, num_segments=k)
+        counts = jax.ops.segment_sum(jnp.ones((x.shape[0],), jnp.float32),
+                                     labels, num_segments=k)
+    else:  # weighted centroid update: Σ wᵢxᵢ / Σ wᵢ
+        sums = jax.ops.segment_sum(xf * w[:, None], labels, num_segments=k)
+        counts = jax.ops.segment_sum(w, labels, num_segments=k)
     return sums, counts
 
 
 def _new_centroids(sums, counts, old):
-    safe = jnp.maximum(counts[:, None], 1.0)
+    # divide by the actual (possibly fractional, with sample_weight) mass;
+    # clamping to 1.0 would leave sub-unit-weight clusters unnormalized
+    safe = jnp.where(counts[:, None] > 0, counts[:, None], 1.0)
     fresh = sums / safe
     # empty clusters keep their previous position (reference keeps/reseeds)
     return jnp.where(counts[:, None] > 0, fresh, old)
 
 
-def kmeans_plus_plus_init(key, x, k: int, *, tile: int = 4096) -> jax.Array:
-    """k-means++ seeding: D²-weighted sequential sampling, as one lax.scan."""
+def kmeans_plus_plus_init(key, x, k: int, *, tile: int = 4096,
+                          sample_weight=None) -> jax.Array:
+    """k-means++ seeding: (w·D²)-weighted sequential sampling, one lax.scan."""
     x = jnp.asarray(x)
     n = x.shape[0]
     k0, key = jax.random.split(key)
-    first = x[jax.random.randint(k0, (), 0, n)]
+    w = None if sample_weight is None else jnp.asarray(sample_weight,
+                                                       jnp.float32)
+    if w is None:
+        first = x[jax.random.randint(k0, (), 0, n)]
+    else:  # the first center is weight-sampled too
+        first = x[jax.random.choice(k0, n, p=w / jnp.maximum(jnp.sum(w),
+                                                             1e-30))]
     xf = x.astype(jnp.float32)
 
     def d2_to(c):
@@ -95,7 +110,8 @@ def kmeans_plus_plus_init(key, x, k: int, *, tile: int = 4096) -> jax.Array:
 
     def step(carry, sk):
         mind2 = carry
-        p = mind2 / jnp.maximum(jnp.sum(mind2), 1e-30)
+        score = mind2 if w is None else mind2 * w
+        p = score / jnp.maximum(jnp.sum(score), 1e-30)
         idx = jax.random.choice(sk, n, p=p)
         c = x[idx]
         mind2 = jnp.minimum(mind2, d2_to(c))
@@ -107,12 +123,15 @@ def kmeans_plus_plus_init(key, x, k: int, *, tile: int = 4096) -> jax.Array:
 
 
 @partial(jax.jit, static_argnames=("k", "max_iter", "init"))
-def _fit_impl(x, key, k: int, max_iter: int, tol: float, init: str):
+def _fit_impl(x, key, k: int, max_iter: int, tol: float, init: str, w=None):
     if init == "kmeans++":
-        c0 = kmeans_plus_plus_init(key, x, k)
+        c0 = kmeans_plus_plus_init(key, x, k, sample_weight=w)
     else:
         idx = jax.random.choice(key, x.shape[0], (k,), replace=False)
         c0 = x[idx]
+
+    def inertia_of(d2):
+        return jnp.sum(d2) if w is None else jnp.sum(d2 * w)
 
     def cond(state):
         _, prev_inertia, inertia, it = state
@@ -123,40 +142,56 @@ def _fit_impl(x, key, k: int, max_iter: int, tol: float, init: str):
     def body(state):
         c, _, inertia, it = state
         labels, d2 = _assign(x, c)
-        sums, counts = _update(x, labels, k)
+        sums, counts = _update(x, labels, k, w)
         c2 = _new_centroids(sums, counts, c)
-        return c2, inertia, jnp.sum(d2), it + 1
+        return c2, inertia, inertia_of(d2), it + 1
 
     # one warmup Lloyd step so `inertia` holds a real value entering the loop
     c0 = c0.astype(jnp.float32)
     labels, d2 = _assign(x, c0)
-    sums, counts = _update(x, labels, k)
-    state = (_new_centroids(sums, counts, c0), jnp.float32(jnp.inf), jnp.sum(d2), jnp.int32(1))
+    sums, counts = _update(x, labels, k, w)
+    state = (_new_centroids(sums, counts, c0), jnp.float32(jnp.inf),
+             inertia_of(d2), jnp.int32(1))
     c, _, inertia, n_iter = jax.lax.while_loop(cond, body, state)
     labels, d2 = _assign(x, c)
-    return c.astype(x.dtype), labels, jnp.sum(d2), n_iter
+    return c.astype(x.dtype), labels, inertia_of(d2), n_iter
 
 
 def kmeans_fit(
     x,
     params: Optional[KMeansParams] = None,
     *,
+    sample_weight=None,
     mesh: Optional[Mesh] = None,
     axis: str = "shard",
     res=None,
 ):
     """Fit centroids. Returns ``(centroids, inertia, n_iter)``.
 
+    ``sample_weight``: optional (n,) per-row weights (classic
+    ``cluster::kmeans`` sample_weights parity) — weighted centroid
+    updates, weighted inertia, and (w·D²)-weighted k-means++ seeding.
+
     With ``mesh``, rows are sharded over ``axis`` and each Lloyd step psums
     partial statistics over ICI (multi-chip data-parallel fit).
+    ``sample_weight`` is single-device-only for now (the sharded program
+    rejects it rather than silently ignoring the weights).
     """
     p = params or KMeansParams()
     x = wrap_array(x, ndim=2, name="x")
     expects(p.n_clusters <= x.shape[0], "n_clusters exceeds n_rows")
+    w = None
+    if sample_weight is not None:
+        w = jnp.asarray(sample_weight, jnp.float32)
+        expects(w.shape == (x.shape[0],),
+                f"sample_weight shape {w.shape} != ({x.shape[0]},)")
     key = jax.random.PRNGKey(p.seed)
     if mesh is None:
-        c, _, inertia, n_iter = _fit_impl(x, key, p.n_clusters, p.max_iter, p.tol, p.init)
+        c, _, inertia, n_iter = _fit_impl(x, key, p.n_clusters, p.max_iter,
+                                          p.tol, p.init, w)
         return c, inertia, n_iter
+    expects(w is None, "sample_weight with mesh= is not supported yet; "
+                       "fit per-shard weights via the single-device path")
     return _fit_sharded(x, key, p, mesh, axis)
 
 

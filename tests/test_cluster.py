@@ -87,3 +87,49 @@ def test_kmeans_balanced_fit_predict(rng):
     c, labels, sizes, _ = kmeans_balanced_fit_predict(x, p)
     ari = float(adjusted_rand_index(np.asarray(labels), y))
     assert ari > 0.8, f"balanced ARI {ari}"
+
+
+def test_kmeans_sample_weight():
+    """Weighted fit (classic cluster::kmeans sample_weights parity):
+    heavily-weighted points dominate their centroid."""
+    import jax.numpy as jnp
+
+    from raft_tpu.cluster import KMeansParams, kmeans_fit
+
+    rng = np.random.default_rng(5)
+    a = rng.normal(0.0, 0.05, (100, 2)).astype(np.float32)
+    b = rng.normal(4.0, 0.05, (100, 2)).astype(np.float32)
+    outlier = np.array([[100.0, 100.0]], np.float32)
+    x = np.concatenate([a, b, outlier])
+    w = np.ones(201, np.float32)
+    w[-1] = 1e-6  # the outlier is almost weightless
+    c, inertia, _ = kmeans_fit(x, KMeansParams(n_clusters=2, seed=3),
+                               sample_weight=w)
+    c = np.sort(np.asarray(c)[:, 0])
+    # both centroids land on the real clusters, not the outlier
+    assert abs(c[0] - 0.0) < 0.5 and abs(c[1] - 4.0) < 0.5, c
+    # weighted inertia excludes (almost all of) the outlier's huge d2
+    assert float(inertia) < 100.0
+
+
+def test_kmeans_sample_weight_validation():
+    from raft_tpu.cluster import KMeansParams, kmeans_fit
+    from raft_tpu.core.errors import LogicError
+
+    x = np.random.default_rng(0).random((50, 4)).astype(np.float32)
+    with pytest.raises(LogicError):
+        kmeans_fit(x, KMeansParams(n_clusters=4), sample_weight=np.ones(10))
+
+
+def test_kmeans_uniform_small_weights_match_unweighted():
+    """sample_weight=c (any constant) must reproduce the unweighted fit —
+    the fractional-mass normalization regression test."""
+    from raft_tpu.cluster import KMeansParams, kmeans_fit
+
+    x = np.random.default_rng(7).normal(size=(300, 4)).astype(np.float32)
+    p = KMeansParams(n_clusters=8, seed=1, init="random")
+    c0, i0, _ = kmeans_fit(x, p)
+    c1, i1, _ = kmeans_fit(x, p, sample_weight=np.full(300, 0.01, np.float32))
+    np.testing.assert_allclose(np.asarray(c1), np.asarray(c0), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(float(i1), 0.01 * float(i0), rtol=1e-4)
