@@ -41,17 +41,14 @@ from __future__ import annotations
 
 import json
 import os
+import signal
+import subprocess
 import sys
 import time
 import traceback
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench"))
-
-if os.environ.get("RAFT_BENCH_PLATFORM"):  # e.g. =cpu for smoke runs
-    import jax
-
-    jax.config.update("jax_platforms", os.environ["RAFT_BENCH_PLATFORM"])
 
 N_DB = int(os.environ.get("RAFT_BENCH_BF_ROWS", 1_000_000))
 N_QUERY = min(10_000, max(100, N_DB // 100))
@@ -264,113 +261,342 @@ def _bench_ivf_flat_kmeans(rows=None):
             "best": best}
 
 
-def main() -> None:
-    north_star = {}
-    t_start = time.time()
+# ---------------------------------------------------------------------------
+# Orchestration (round-4 redesign, VERDICT r3 weak #1/#6).
+#
+# Round 3 was lost to a wedged TPU tunnel: the bench process imported jax,
+# the import hung, and the driver's external timeout (rc=124) killed it
+# before any final JSON line existed.  The fix is structural:
+#
+#   * The PARENT process never imports jax.  It cannot hang on a wedged
+#     backend; it only orchestrates subprocesses.
+#   * A bounded PROBE subprocess runs one real matmul before the ladder.
+#     If the backend is wedged, the final line (with an ``error`` field)
+#     prints immediately and the process exits 0.
+#   * Each config runs in its own WATCHDOGGED subprocess — a hung jax op
+#     costs at most that config's timeout, never the driver window.
+#   * SIGTERM/SIGINT flush the final line with whatever completed.
+#   * The final-format line is re-printed after every config, so even
+#     SIGKILL leaves the most recent complete snapshot as the last JSON
+#     line on stdout (the driver parses the tail).
+#   * The ratchet history is written incrementally after each config.
+#
+# Test hooks (exercised by tests/test_bench_robustness.py):
+#   RAFT_BENCH_FAKE_WEDGE=1      — probe child sleeps forever (wedged tunnel)
+#   RAFT_BENCH_FAKE_SLOW_CONFIG=1 — config children sleep forever (hung op)
+# ---------------------------------------------------------------------------
 
-    try:
-        qps, recall, profile = _bench_brute_force()
-        print(json.dumps({"config": "brute_force_1Mx128", "qps": round(qps, 2),
-                          "recall": round(recall, 5), "profile": profile}))
-    except Exception as e:  # noqa: BLE001 — the final line must still print
-        traceback.print_exc()
-        qps, recall, profile = 0.0, 0.0, {"error": f"{type(e).__name__}: {e}"}
+PROBE_TIMEOUT_S = float(os.environ.get("RAFT_BENCH_PROBE_TIMEOUT_S", 180))
 
-    for name, fn, full_rows, floor, short in (
-            ("ivf_pq_deep10m_class", _bench_ivf_pq, PQ_ROWS, 100_000, "ivf_pq"),
-            ("cagra_1m", _bench_cagra, CAGRA_ROWS, 100_000, "cagra"),
-            ("pairwise_10kx128", _bench_pairwise, 10_000, 1_000, "pairwise"),
-            ("ivf_flat_kmeans_1m", _bench_ivf_flat_kmeans, IF_ROWS, 100_000,
-             "ivf_flat")):
-        if short in SKIP:
-            continue
-        if time.time() - t_start > BUDGET_S:
-            north_star[name] = {"skipped": "budget",
-                                "elapsed_s": round(time.time() - t_start, 1)}
-            print(json.dumps({"config": name, **north_star[name]}))
-            continue
+_PROBE_SRC = """
+import os, time
+if os.environ.get("RAFT_BENCH_FAKE_WEDGE"):
+    time.sleep(3600)
+import jax
+if os.environ.get("RAFT_BENCH_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["RAFT_BENCH_PLATFORM"])
+import jax.numpy as jnp
+(jnp.ones((128, 128), jnp.float32) @ jnp.ones((128, 128), jnp.float32)).sum().item()
+print("PROBE_OK", jax.default_backend())
+"""
+
+# The one table every per-config decision reads: --config key (= SKIP key),
+# north-star name, bench fn, full-scale rows, retry floor, watchdog cap.
+# Timeout caps are generous; the budget guard, not these, bounds the normal
+# ladder — the caps only bound the damage of a mid-run tunnel wedge.
+_CONFIGS = (
+    ("brute_force", "brute_force_1Mx128", _bench_brute_force, None, None, 1500),
+    ("ivf_pq", "ivf_pq_deep10m_class", _bench_ivf_pq, PQ_ROWS, 100_000, 2700),
+    ("cagra", "cagra_1m", _bench_cagra, CAGRA_ROWS, 100_000, 2100),
+    ("pairwise", "pairwise_10kx128", _bench_pairwise, 10_000, 1_000, 600),
+    ("ivf_flat", "ivf_flat_kmeans_1m", _bench_ivf_flat_kmeans, IF_ROWS,
+     100_000, 1800),
+)
+
+
+def _config_row(short: str):
+    return next(row for row in _CONFIGS if row[0] == short)
+
+
+def _config_timeout(short: str) -> float:
+    env = os.environ.get("RAFT_BENCH_CONFIG_TIMEOUT_S")
+    return float(env) if env else float(_config_row(short)[5])
+
+
+def _child_main(short: str) -> None:
+    """Run ONE config in this process (invoked as a watchdogged subprocess).
+
+    The last stdout line is the config's result JSON — errors included, so
+    the parent never has to guess why a child produced nothing.
+    """
+    if os.environ.get("RAFT_BENCH_FAKE_SLOW_CONFIG"):  # test hook: hung op
+        time.sleep(3600)
+    if os.environ.get("RAFT_BENCH_PLATFORM"):  # e.g. =cpu for smoke runs
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["RAFT_BENCH_PLATFORM"])
+
+    _, name, fn, full_rows, floor, _ = _config_row(short)
+    if short == "brute_force":
         try:
-            res = fn()
-            north_star[name] = res
-            print(json.dumps({"config": name, **res}))
-        except Exception as e:  # noqa: BLE001 — keep the headline alive
+            qps, recall, profile = _bench_brute_force()
+            res = {"qps": round(qps, 2), "recall": round(recall, 5),
+                   "profile": profile}
+        except Exception as e:  # noqa: BLE001 — result line must still print
             traceback.print_exc()
-            # a quarter-scale number still anchors the curve; an OOM at
-            # full scale must not zero out the whole config.  The floor is
-            # per-config: clamping every retry up to 100k would scale the
-            # 10k pairwise config UP on failure
-            retry_rows = min(full_rows, max(floor, full_rows // 4))
-            if retry_rows == full_rows:  # nothing smaller to try
-                north_star[name] = {"error": f"{type(e).__name__}: {e}"}
-                continue
+            res = {"qps": 0.0, "recall": 0.0,
+                   "profile": {"error": f"{type(e).__name__}: {e}"}}
+        print(json.dumps({"config": name, **res}), flush=True)
+        return
+    try:
+        res = fn()
+    except Exception as e:  # noqa: BLE001 — keep the ladder alive
+        traceback.print_exc()
+        # a quarter-scale number still anchors the curve; an OOM at full
+        # scale must not zero out the whole config.  The floor is
+        # per-config: clamping every retry up to 100k would scale the
+        # 10k pairwise config UP on failure
+        retry_rows = min(full_rows, max(floor, full_rows // 4))
+        if retry_rows == full_rows:  # nothing smaller to try
+            res = {"error": f"{type(e).__name__}: {e}"}
+        else:
             try:
                 res = fn(rows=retry_rows)
                 res["reduced_scale"] = True
-                north_star[name] = res
-                print(json.dumps({"config": name, **res}))
             except Exception as e2:  # noqa: BLE001
-                north_star[name] = {
-                    "error": f"{type(e).__name__}: {e}",
-                    "retry_error": f"{type(e2).__name__}: {e2}"}
                 traceback.print_exc()
+                res = {"error": f"{type(e).__name__}: {e}",
+                       "retry_error": f"{type(e2).__name__}: {e2}"}
+    print(json.dumps({"config": name, **res}), flush=True)
 
-    hist = {}
+
+def _probe(timeout_s: float, state=None):
+    """Bounded backend-health check in a subprocess (a real matmul — on the
+    remote-TPU tunnel, backend init can succeed while the compute leg is
+    wedged).  Returns (ok, backend_name_or_error).  The child is registered
+    in ``state["child"]`` so the SIGTERM handler can kill it — an orphaned
+    probe client would hold the single-client tunnel wedged after we exit."""
+    p = subprocess.Popen([sys.executable, "-c", _PROBE_SRC],
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         text=True)
+    if state is not None:
+        state["child"] = p
     try:
-        with open(HISTORY) as f:
-            hist = json.load(f)
-    except (OSError, json.JSONDecodeError):
-        pass
-    prev = hist.get("knn_qps")
-    vs = (qps / prev) if prev else 1.0
-    if prev is None or qps > prev:  # record recall only with the run it belongs to
-        hist.update({"knn_qps": qps, "recall": recall, "protocol": PROTOCOL})
-    for name, field, key in (
-            ("ivf_pq_deep10m_class", "qps_at_recall95", "ivf_pq_qps95"),
-            ("cagra_1m", "qps_at_recall95", "cagra_qps95"),
-            ("ivf_flat_kmeans_1m", "qps_at_recall95", "ivf_flat_qps95"),
-            ("pairwise_10kx128", "tflops", "pairwise_tflops"),
-            ("ivf_flat_kmeans_1m", "kmeans_rows_per_s", "kmeans_rows_s")):
-        res = north_star.get(name) or {}
-        val = res.get(field)
-        # reduced-scale retries report but never ratchet (smaller corpus =
-        # inflated numbers; each key tracks the full-scale config only)
-        if val is not None and not res.get("reduced_scale") \
-                and val > hist.get(key, 0):
-            hist[key] = val
-    # only production (TPU, full-scale) runs may move the ratchet — CPU
-    # smoke runs at reduced RAFT_BENCH_* scales must not pollute history
-    import jax
+        out, err = p.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        p.kill()
+        p.communicate()
+        return False, f"probe timed out after {timeout_s:.0f}s (backend wedged)"
+    finally:
+        if state is not None:
+            state["child"] = None
+    for line in reversed(out.splitlines()):
+        if line.startswith("PROBE_OK"):
+            return True, line.split()[1]
+    tail = (err or out or "").strip().splitlines()[-3:]
+    return False, f"probe failed rc={p.returncode}: {' | '.join(tail)}"
 
-    record = jax.default_backend() == "tpu" and not any(
+
+def _is_record_run(backend) -> bool:
+    """Only production (TPU, full-scale) runs may move the ratchet or claim
+    the canonical 1M label — reduced RAFT_BENCH_* smoke runs must not
+    pollute history.  The single home of the predicate (label + ratchet
+    must never disagree)."""
+    return backend == "tpu" and not any(
         k in os.environ for k in ("RAFT_BENCH_BF_ROWS", "RAFT_BENCH_PQ_ROWS",
                                   "RAFT_BENCH_CAGRA_ROWS", "RAFT_BENCH_IF_ROWS"))
-    if record:
-        try:
-            with open(HISTORY, "w") as f:
-                json.dump(hist, f)
-        except OSError:
-            pass
 
-    # the canonical label names the full-scale config; reduced smoke runs
-    # must not masquerade as (or be ratioed against) 1M-scale numbers
-    if record:
-        label = "brute_force_knn_qps_1Mx128_k10_recall>=0.999"
-    else:
-        label = f"brute_force_knn_qps_{N_DB}x{DIM}_k{K}_smoke"
-        vs = 0.0
-    print(json.dumps({
-        "metric": label,
-        "value": round(qps, 2),
-        "unit": "queries/s",
-        "vs_baseline": round(vs, 4),
-        "profile": profile,
-        "north_star": {
-            name: {k: v for k, v in res.items() if k != "curve"}
-            if isinstance(res, dict) else res
-            for name, res in north_star.items()
-        },
-    }))
+
+def _load_history() -> dict:
+    try:
+        with open(HISTORY) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+_RATCHET_KEYS = (
+    ("ivf_pq_deep10m_class", "qps_at_recall95", "ivf_pq_qps95"),
+    ("cagra_1m", "qps_at_recall95", "cagra_qps95"),
+    ("ivf_flat_kmeans_1m", "qps_at_recall95", "ivf_flat_qps95"),
+    ("pairwise_10kx128", "tflops", "pairwise_tflops"),
+    ("ivf_flat_kmeans_1m", "kmeans_rows_per_s", "kmeans_rows_s"),
+)
+
+
+def main() -> None:
+    t_start = time.time()
+    hist = _load_history()
+    prev = hist.get("knn_qps")
+    state = {"north_star": {}, "qps": 0.0, "recall": 0.0, "profile": {},
+             "backend": None, "error": None, "child": None, "done": 0}
+
+    def flush_final() -> None:
+        """Print the final-format line reflecting everything completed so
+        far.  Called after every config (and from the signal handler), so
+        the last JSON line on stdout is always the best snapshot."""
+        qps = state["qps"]
+        record = _is_record_run(state["backend"])
+        # the canonical label names the full-scale config; reduced smoke
+        # runs must not masquerade as (or be ratioed against) 1M-scale
+        if record:
+            label = "brute_force_knn_qps_1Mx128_k10_recall>=0.999"
+            vs = (qps / prev) if prev else 1.0
+        else:
+            label = f"brute_force_knn_qps_{N_DB}x{DIM}_k{K}_smoke"
+            vs = 0.0
+        line = {
+            "metric": label,
+            "value": round(qps, 2),
+            "unit": "queries/s",
+            "vs_baseline": round(vs, 4),
+            "backend": state["backend"],
+            "configs_done": state["done"],
+            "elapsed_s": round(time.time() - t_start, 1),
+            "profile": state["profile"],
+            "north_star": {
+                name: {k: v for k, v in res.items() if k != "curve"}
+                if isinstance(res, dict) else res
+                for name, res in state["north_star"].items()
+            },
+        }
+        if state["error"]:
+            line["error"] = state["error"]
+        print(json.dumps(line), flush=True)
+
+    def on_signal(signum, frame):  # noqa: ARG001 — signal API
+        child = state.get("child")
+        if child is not None:
+            try:
+                child.kill()
+            except OSError:
+                pass
+        state["error"] = state["error"] or f"killed by signal {signum}"
+        flush_final()
+        sys.stdout.flush()
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+
+    ok, info = _probe(PROBE_TIMEOUT_S, state)
+    if not ok:
+        state["error"] = f"backend unavailable: {info}"
+        flush_final()
+        return
+    state["backend"] = info
+    record = _is_record_run(info)
+
+    def run_config(short: str):
+        """One config in a watchdogged subprocess; returns its result dict."""
+        timeout_s = _config_timeout(short)
+        cmd = [sys.executable, os.path.abspath(__file__), "--config", short]
+        p = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
+        state["child"] = p
+        def forward(text):
+            if text:
+                sys.stdout.write(text)
+                if not text.endswith("\n"):
+                    # a killed child can die mid-line; an unterminated line
+                    # would glue itself to our next JSON line and corrupt
+                    # the driver's tail parse
+                    sys.stdout.write("\n")
+                sys.stdout.flush()
+
+        def parse_result(text):
+            for line in reversed(text.splitlines()):
+                try:
+                    d = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(d, dict) and d.get("config"):
+                    return d
+            return None
+
+        try:
+            out, _ = p.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+            forward(out)
+            # the child may have PRINTED its result and then hung in
+            # teardown on the wedged tunnel — a completed measurement must
+            # not be discarded for dying badly
+            res = parse_result(out or "")
+            if res is not None:
+                res["post_timeout_kill"] = True
+                return res
+            return {"skipped": "watchdog_timeout", "timeout_s": timeout_s}
+        finally:
+            state["child"] = None
+        forward(out)  # per-config lines stay on stdout
+        res = parse_result(out or "")
+        if res is not None:
+            return res
+        return {"error": f"config subprocess rc={p.returncode}, no result line"}
+
+    def ratchet(short: str, res: dict) -> None:
+        """Fold one config's result into BENCH_HISTORY (written after every
+        config so a later kill cannot lose an earlier result)."""
+        if short == "brute_force":
+            if state["qps"] > (hist.get("knn_qps") or 0):
+                hist.update({"knn_qps": state["qps"],
+                             "recall": state["recall"],
+                             "protocol": PROTOCOL})
+        for name, field, key in _RATCHET_KEYS:
+            r = state["north_star"].get(name) or {}
+            val = r.get(field)
+            # reduced-scale retries report but never ratchet (smaller
+            # corpus = inflated numbers; keys track the full-scale config)
+            if val is not None and not r.get("reduced_scale") \
+                    and val > hist.get(key, 0):
+                hist[key] = val
+        if record:
+            try:
+                # atomic replace: a SIGTERM between configs must never be
+                # able to truncate the ratchet file mid-write
+                tmp = HISTORY + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(hist, f)
+                os.replace(tmp, HISTORY)
+            except OSError:
+                pass
+
+    for short, name, *_ in _CONFIGS:
+        if short != "brute_force" and short in SKIP:
+            continue
+        if short != "brute_force" and time.time() - t_start > BUDGET_S:
+            state["north_star"][name] = {
+                "skipped": "budget",
+                "elapsed_s": round(time.time() - t_start, 1)}
+            print(json.dumps({"config": name,
+                              **state["north_star"][name]}), flush=True)
+            continue
+        res = run_config(short)
+        res.pop("config", None)
+        if short == "brute_force":
+            state["qps"] = float(res.get("qps") or 0.0)
+            state["recall"] = float(res.get("recall") or 0.0)
+            state["profile"] = res.get("profile") or \
+                {k: v for k, v in res.items() if k != "qps"}
+        else:
+            state["north_star"][name] = res
+        state["done"] += 1
+        ratchet(short, res)
+        flush_final()
+        if res.get("skipped") == "watchdog_timeout" or \
+                res.get("post_timeout_kill"):
+            # a killed client can wedge the tunnel for every later config;
+            # re-probe before burning more watchdog windows on a dead link
+            ok2, info2 = _probe(min(PROBE_TIMEOUT_S, 120), state)
+            if not ok2:
+                state["error"] = f"backend lost mid-run: {info2}"
+                break
+    flush_final()
 
 
 if __name__ == "__main__":
-    main()
+    if "--config" in sys.argv:
+        _child_main(sys.argv[sys.argv.index("--config") + 1])
+    else:
+        main()

@@ -64,9 +64,14 @@ def build(index_params: IndexParams, dataset, handle=None):
     if not index_params.add_data_on_build:
         from .ivf_flat import _clear_lists
 
-        idx = _clear_lists(idx)
         if idx.recon is not None:
-            idx = idx.with_recon()  # re-derive the slab from cleared lists
+            # drop-then-rebuild: ``with_recon`` is an idempotent no-op on an
+            # index that still holds the stale full-dataset slab, so force
+            # re-derivation from the cleared lists (cleared ids decode to
+            # +inf recon_norms, masking every slot in recon-mode search)
+            idx = _clear_lists(idx).without_recon().with_recon()
+        else:
+            idx = _clear_lists(idx)
     return idx
 
 

@@ -96,23 +96,37 @@ def as_keep_mask(filter, n=None, nq=None):
 _max_id_cache: dict = {}
 
 
-def _max_source_id(ids) -> int:
-    """max(ids) with a per-array cache: it is a build-time constant, and
-    recomputing it would put a device reduction + host sync on every
-    filtered search dispatch.  Keyed by id() with a weakref guard, so a
-    recycled id() can never return a stale value."""
+def cached_by_id(cache: dict, obj, compute, bound: int = 256):
+    """id()-keyed memo for a host scalar derived from a device array —
+    avoids putting a device reduction + host sync on every dispatch when
+    the same object is reused across calls.  A weakref guard ensures a
+    recycled id() can never return a stale value.  Dead entries are purged
+    at the bound, and the bound holds even when every entry is live (a
+    process holding hundreds of loaded indexes): oldest-inserted entries
+    are evicted FIFO — a refill costs one recompute, not correctness."""
     import weakref
 
-    key = id(ids)
-    hit = _max_id_cache.get(key)
-    if hit is not None and hit[0]() is ids:
+    key = id(obj)
+    hit = cache.get(key)
+    if hit is not None and hit[0]() is obj:
         return hit[1]
-    val = int(jnp.max(ids))
-    if len(_max_id_cache) > 256:  # drop dead entries, bound growth
-        for k in [k for k, (r, _) in _max_id_cache.items() if r() is None]:
-            del _max_id_cache[k]
-    _max_id_cache[key] = (weakref.ref(ids), val)
+    val = compute()
+    try:
+        ref = weakref.ref(obj)
+    except TypeError:
+        return val  # un-weakref-able subject (e.g. a list) — skip caching
+    if len(cache) > bound:
+        for k in [k for k, (r, _) in cache.items() if r() is None]:
+            del cache[k]
+        while len(cache) > bound:
+            del cache[next(iter(cache))]
+    cache[key] = (ref, val)
     return val
+
+
+def _max_source_id(ids) -> int:
+    """max(ids) — a build-time constant, memoized per id-array object."""
+    return cached_by_id(_max_id_cache, ids, lambda: int(jnp.max(ids)))
 
 
 def check_filter_covers_ids(keep, ids):

@@ -256,6 +256,29 @@ def _fast_knn_impl(x, y, k: int, metric: str, cand: int, bm: int, bn: int,
     return vals, jnp.take_along_axis(short, p2, axis=1)
 
 
+_excl_cache: dict = {}
+_excl_checked: set = set()
+
+
+def _bitmap_max_exclusions(filter_obj, keep):
+    """Worst query's exclusion count among globally-wanted rows — the
+    headroom a fast-mode shortlist needs over k (ADVICE r3).  Because
+    ``row_keep = any_q keep[q]`` every query's exclusion count among wanted
+    rows is ``popcount(row_keep) − popcount(keep[q])``: two row reductions,
+    no (nq, n) intermediate.  Memoized per mask object; returns None when
+    tracing (abstract mask inside user jit)."""
+    from ._packing import cached_by_id
+
+    def compute():
+        return int(jnp.sum(jnp.any(keep, axis=0))
+                   - jnp.min(jnp.sum(keep, axis=1)))
+
+    try:
+        return cached_by_id(_excl_cache, filter_obj, compute)
+    except jax.errors.ConcretizationTypeError:
+        return None
+
+
 def knn(
     queries,
     database,
@@ -298,6 +321,32 @@ def knn(
 
     keep = as_keep_mask(filter, y.shape[0], nq=x.shape[0])
     expects(cut in ("exact", "approx"), f"unknown cut {cut!r}")
+    # effective shortlist width: the impl clamps cand to the database size,
+    # and a whole-database shortlist is exhaustive — it cannot starve
+    cand_eff = min(max(cand, k), y.shape[0])
+    if mode == "fast" and keep is not None and keep.ndim == 2 \
+            and cand_eff < y.shape[0] \
+            and (keep.shape, cand_eff, k) not in _excl_checked:
+        # serving loops build a FRESH mask per batch (id-cache misses every
+        # call) but at a constant shape: checking once per (shape, cand, k)
+        # keeps the detection while paying the host sync on the first batch
+        # only, never per dispatch
+        max_excl = _bitmap_max_exclusions(filter, keep)
+        if max_excl is not None:
+            if len(_excl_checked) > 4096:
+                _excl_checked.clear()
+            _excl_checked.add((keep.shape, cand_eff, k))
+            if cand_eff < min(k + max_excl, y.shape[0]):
+                from ..core.logging import default_logger
+
+                default_logger().warning(
+                    "bitmap-filtered fast knn: a query excludes up to %d "
+                    "shortlist-eligible rows but cand=%d leaves only %d slots "
+                    "of headroom over k=%d; results may carry -1/inf "
+                    "sentinels — use cand >= k + max per-query exclusions "
+                    "(%d) or mode='exact'",
+                    max_excl, cand_eff, cand_eff - k, k,
+                    min(k + max_excl, y.shape[0]))
     if mode == "fast":
         vals, ids = _fast_knn_impl(x, y, int(k), metric, int(max(cand, k)),
                                    1024, 1024, keep, cut)

@@ -1,0 +1,85 @@
+"""``bench.py`` must emit a parseable final JSON line no matter what the
+backend does (VERDICT r3 weak #1/#6: a wedged TPU tunnel erased the round's
+bench artifact).  These tests wedge the backend deliberately — via the
+documented test hooks — and assert the driver contract survives:
+
+* wedged backend at probe time → final line with ``error``, exit 0, fast;
+* a hung jax op inside a config → per-config watchdog fires, ladder
+  continues, final line still prints;
+* SIGTERM mid-run (the driver's external timeout) → handler flushes the
+  final line with whatever completed.
+
+The parent bench process never imports jax, so the tests drive the real
+``python bench.py`` entry end-to-end in subprocesses.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+BENCH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "bench.py")
+
+
+def _env(**extra):
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env.update({"JAX_PLATFORMS": "cpu", "RAFT_BENCH_PLATFORM": "cpu"})
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def _final_line(stdout: str) -> dict:
+    lines = [ln for ln in stdout.splitlines() if ln.startswith("{")]
+    assert lines, f"no JSON lines in output:\n{stdout}"
+    d = json.loads(lines[-1])
+    assert "metric" in d and "value" in d, d
+    return d
+
+
+def test_wedged_probe_emits_final_line_fast():
+    t0 = time.time()
+    p = subprocess.run([sys.executable, BENCH], capture_output=True, text=True,
+                       timeout=120,
+                       env=_env(RAFT_BENCH_FAKE_WEDGE=1,
+                                RAFT_BENCH_PROBE_TIMEOUT_S=3))
+    assert p.returncode == 0
+    d = _final_line(p.stdout)
+    assert "backend unavailable" in d["error"]
+    assert d["value"] == 0.0
+    assert time.time() - t0 < 60
+
+
+def test_hung_config_watchdog_keeps_ladder_alive():
+    p = subprocess.run([sys.executable, BENCH], capture_output=True, text=True,
+                       timeout=300,
+                       env=_env(RAFT_BENCH_FAKE_SLOW_CONFIG=1,
+                                RAFT_BENCH_CONFIG_TIMEOUT_S=3,
+                                RAFT_BENCH_SKIP="ivf_pq,cagra,ivf_flat"))
+    assert p.returncode == 0
+    d = _final_line(p.stdout)
+    assert d["configs_done"] == 2  # brute_force + pairwise both attempted
+    assert d["profile"].get("skipped") == "watchdog_timeout"
+    assert d["north_star"]["pairwise_10kx128"]["skipped"] == "watchdog_timeout"
+    assert "error" not in d  # backend stayed healthy; ladder ran to the end
+
+
+@pytest.mark.skipif(sys.platform == "win32", reason="POSIX signals")
+def test_sigterm_flushes_final_line():
+    p = subprocess.Popen([sys.executable, BENCH], stdout=subprocess.PIPE,
+                         stderr=subprocess.DEVNULL, text=True,
+                         env=_env(RAFT_BENCH_FAKE_SLOW_CONFIG=1,
+                                  RAFT_BENCH_CONFIG_TIMEOUT_S=600))
+    # wait for the probe to pass (config child then hangs), then TERM
+    time.sleep(20)
+    p.send_signal(signal.SIGTERM)
+    out, _ = p.communicate(timeout=60)
+    assert p.returncode == 0
+    d = _final_line(out)
+    assert "signal" in d["error"]

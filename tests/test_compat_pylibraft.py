@@ -203,6 +203,27 @@ def test_neighbors_add_data_on_build_false():
     assert (np.asarray(i)[:, 0] == np.arange(6)).all()  # no duplicates
 
 
+def test_neighbors_add_data_on_build_false_ivf_pq():
+    """The empty index must be empty in EVERY search tier: the recon slab
+    built from the training dataset must not survive ``_clear_lists``
+    (ADVICE r3: stale slab returned finite recon-mode distances)."""
+    from raft_tpu.compat.pylibraft.neighbors import ivf_pq
+
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((300, 16)).astype(np.float32)
+    idx = ivf_pq.build(ivf_pq.IndexParams(n_lists=8, pq_dim=8,
+                                          add_data_on_build=False), x)
+    assert int(np.asarray(idx.counts).sum()) == 0
+    # recon-mode search on the empty index: every slot masked
+    d, i = ivf_pq.search(ivf_pq.SearchParams(n_probes=8), idx, x[:4], 3)
+    assert (np.asarray(i) == -1).all()
+    assert not np.isfinite(np.asarray(d)).any()
+    # extend then search: results come from the extended rows only
+    idx = ivf_pq.extend(idx, x, np.arange(300))
+    d, i = ivf_pq.search(ivf_pq.SearchParams(n_probes=8), idx, x[:6], 1)
+    assert (np.asarray(i)[:, 0] == np.arange(6)).all()
+
+
 def test_neighbors_out_params_filled():
     from raft_tpu.compat.pylibraft.neighbors import brute_force
 

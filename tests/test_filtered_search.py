@@ -257,6 +257,48 @@ class TestBitmapFilter:
         with pytest.raises(LogicError, match="bitmap filter has 5"):
             brute_force.knn(q, x, 1, filter=bitmap[:5])
 
+    def test_fast_mode_bitmap_inside_jit(self, bdata):
+        """The headroom check must not concretize a traced mask — fast-mode
+        knn with a bitmap filter stays jittable."""
+        import jax
+
+        x, q, bitmap, gt_all = bdata
+        f = jax.jit(lambda qq, m: brute_force.knn(qq, x, 1, mode="fast",
+                                                  cand=32, filter=m))
+        _, ids = f(q, jnp.asarray(bitmap))
+        np.testing.assert_array_equal(np.asarray(ids)[:, 0], gt_all[:, 1])
+
+    def test_fast_mode_dense_bitmap_warns(self, bdata, caplog):
+        """Dense per-query exclusions with no cand headroom must warn
+        (ADVICE r3: starved shortlists silently return sentinels)."""
+        import logging
+
+        from raft_tpu.neighbors.brute_force import _excl_checked
+
+        x, q, _, _ = bdata
+        n, half = x.shape[0], x.shape[0] // 2
+        dense = np.ones((q.shape[0], n), bool)
+        for i in range(q.shape[0]):  # per-query-DIFFERENT exclusion windows
+            dense[i, i % half: i % half + half] = False
+        _excl_checked.clear()
+        with caplog.at_level(logging.WARNING, logger="raft_tpu"):
+            brute_force.knn(q, x, 4, mode="fast", cand=8, filter=dense)
+        assert any("headroom" in r.getMessage() for r in caplog.records)
+        caplog.clear()
+        # the check runs once per (shape, cand, k): the next dispatch at the
+        # same config must pay no sync and re-raise no warning
+        with caplog.at_level(logging.WARNING, logger="raft_tpu"):
+            brute_force.knn(q, x, 4, mode="fast", cand=8, filter=dense)
+        assert not any("headroom" in r.getMessage() for r in caplog.records)
+        # identical masks for every query carry no starvation risk (the
+        # shared row mask pre-drops them) — no warning
+        same = np.ones((q.shape[0], n), bool)
+        same[:, :half] = False
+        _excl_checked.clear()
+        with caplog.at_level(logging.WARNING, logger="raft_tpu"):
+            brute_force.knn(q, x, 4, mode="fast", cand=8, filter=same)
+        assert not any("headroom" in r.getMessage() for r in caplog.records)
+
 
 class TestCagraFilter:
     @pytest.fixture(scope="class")
