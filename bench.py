@@ -472,6 +472,10 @@ def main() -> None:
             except OSError:
                 pass
         state["error"] = state["error"] or f"killed by signal {signum}"
+        # the signal may have landed mid-write of a previous (non-atomic >
+        # PIPE_BUF) line: a leading newline keeps the handler's JSON from
+        # gluing onto the truncated line (same guard as forward())
+        sys.stdout.write("\n")
         flush_final()
         sys.stdout.flush()
         os._exit(0)
@@ -553,8 +557,17 @@ def main() -> None:
                 hist[key] = val
         if record:
             try:
-                # atomic replace: a SIGTERM between configs must never be
-                # able to truncate the ratchet file mid-write
+                # provenance stamp (VERDICT r3 next #8) + atomic replace (a
+                # SIGTERM between configs must never truncate the ratchet)
+                import datetime
+
+                hist["_meta"] = {"backend": state["backend"],
+                                 "date": datetime.date.today().isoformat(),
+                                 "protocol": PROTOCOL,
+                                 "rows": {"brute_force": N_DB,
+                                          "ivf_pq": PQ_ROWS,
+                                          "cagra": CAGRA_ROWS,
+                                          "ivf_flat": IF_ROWS}}
                 tmp = HISTORY + ".tmp"
                 with open(tmp, "w") as f:
                     json.dump(hist, f)

@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import time
 from functools import partial
-from typing import List, Tuple
+from typing import List
 
 import jax
 import jax.numpy as jnp

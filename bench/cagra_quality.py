@@ -70,8 +70,11 @@ def main() -> None:
     raw_idx = cagra.CagraIndex(idx.dataset, raw_graph, idx.router_centroids,
                                idx.router_nodes, idx.metric)
 
+    import datetime
+
     results = {"rows": rows, "dim": d, "k": k, "build_s": round(build_s, 1),
-               "backend": jax.default_backend(), "points": []}
+               "backend": jax.default_backend(),
+               "date": datetime.date.today().isoformat(), "points": []}
     for itopk, width in [(32, 4), (64, 4), (64, 8), (128, 8)]:
         sp = cagra.CagraSearchParams(itopk_size=itopk, search_width=width)
         row = {"itopk": itopk, "width": width}

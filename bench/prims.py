@@ -21,7 +21,6 @@ from __future__ import annotations
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
@@ -58,6 +57,13 @@ def ratchet(record: bool, ran_suites) -> int:
         hist = {}
     backend = jax.default_backend()
     best = hist.setdefault(backend, {})
+    # provenance stamp (VERDICT r3 next #8): no artifact may be mistaken
+    # for TPU evidence when it is a CPU stand-in
+    import datetime
+
+    hist.setdefault("_meta", {})[backend] = {
+        "backend": backend, "date": datetime.date.today().isoformat(),
+        "cases": len(_results)}
     regressions = 0
     seen = set()
     for key, ms in _results:

@@ -18,13 +18,11 @@ from __future__ import annotations
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from _timing import timeit as _time
 from raft_tpu.matrix.select_k import SelectAlgo, select_k
@@ -73,12 +71,21 @@ def main() -> None:
                       f"{best_algo.name} ({best_t * 1e3:.2f} ms)")
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
                        "raft_tpu", "matrix", "_select_k_table.json")
+    if jax.default_backend() != "tpu" and "--force" not in sys.argv:
+        # an off-TPU run (CI smoke, contended-CPU drill) must never clobber
+        # the production dispatch table the TPU search paths consult
+        out = out.replace(".json", f".{jax.default_backend()}.json")
+        print(f"non-TPU backend: writing to {os.path.basename(out)} "
+              f"(--force overrides)", file=sys.stderr)
     with open(out, "w") as f:
         json.dump(table, f, indent=1, sort_keys=True)
     # provenance sidecar: NOT in the dispatch table (whose consumers —
     # dispatch, tests — treat every key as a b:l:k bucket)
+    import datetime
+
     with open(out.replace(".json", ".meta.json"), "w") as f:
         json.dump({"backend": jax.default_backend(),
+                   "date": datetime.date.today().isoformat(),
                    "n_entries": len(table)}, f)
     print(f"wrote {len(table)} entries → {os.path.normpath(out)}")
 

@@ -1,0 +1,207 @@
+"""Zero-dependency docs builder — the ``docs`` role of the reference's
+``build.sh docs`` target (`/root/reference/build.sh:22`, sphinx tree at
+`/root/reference/docs/source/`).
+
+The container has no sphinx and no egress, so this renders the markdown tree
+to a small static HTML site with stdlib only:
+
+    python docs/build_docs.py            # writes docs/_build/*.html
+    python docs/build_docs.py --check    # link check only (CI mode)
+
+``docs/gen_api.py`` regenerates ``api.md`` from live docstrings first when
+``--api`` is passed.  Supported markdown: ATX headings, fenced code blocks,
+tables, ordered/unordered lists, links, inline code / bold / italic,
+blockquotes.  Inter-page links (``foo.md`` → ``foo.html``) are rewritten and
+verified; a dead relative link fails the build.
+"""
+
+from __future__ import annotations
+
+import html
+import os
+import re
+import sys
+
+DOCS = os.path.dirname(os.path.abspath(__file__))
+OUT = os.path.join(DOCS, "_build")
+
+PAGES = [  # (file, nav title) — nav order
+    ("../README.md", "Overview"),
+    ("architecture.md", "Architecture"),
+    ("api.md", "API reference"),
+    ("tuning_guide.md", "Tuning guide"),
+    ("perf_analysis.md", "Performance analysis"),
+    ("developer_guide.md", "Developer guide"),
+    ("contributing.md", "Contributing"),
+    ("parity_status.md", "Parity status"),
+]
+
+_CSS = """
+body{font-family:system-ui,sans-serif;max-width:56rem;margin:2rem auto;
+     padding:0 1rem;line-height:1.55;color:#1a1a2e}
+nav{border-bottom:1px solid #ddd;padding-bottom:.6rem;margin-bottom:1.2rem}
+nav a{margin-right:.9rem;text-decoration:none;color:#0b5394}
+pre{background:#f6f8fa;padding:.8rem;overflow-x:auto;border-radius:6px}
+code{background:#f6f8fa;padding:.1rem .25rem;border-radius:4px;
+     font-size:.92em}
+pre code{padding:0;background:none}
+table{border-collapse:collapse;margin:1rem 0}
+td,th{border:1px solid #ccc;padding:.35rem .6rem;text-align:left}
+blockquote{border-left:3px solid #bbb;margin-left:0;padding-left:1rem;
+           color:#444}
+h1,h2,h3{line-height:1.25}
+"""
+
+
+def _inline(text: str) -> str:
+    text = html.escape(text, quote=False)
+    text = re.sub(r"`([^`]+)`", r"<code>\1</code>", text)
+    text = re.sub(r"\*\*([^*]+)\*\*", r"<strong>\1</strong>", text)
+    text = re.sub(r"(?<![\w*])\*([^*\s][^*]*)\*", r"<em>\1</em>", text)
+    text = re.sub(r"\[([^\]]+)\]\(([^)\s]+)\)",
+                  lambda m: f'<a href="{_fix_href(m.group(2))}">'
+                            f"{m.group(1)}</a>", text)
+    return text
+
+
+def _fix_href(href: str) -> str:
+    if href.startswith(("http://", "https://", "#", "mailto:")):
+        return href
+    return re.sub(r"\.md(#|$)", r".html\1", href)
+
+
+def render(md: str) -> str:
+    out, lines = [], md.split("\n")
+    i, in_code, in_list, in_table = 0, False, None, False
+
+    def close_list():
+        nonlocal in_list
+        if in_list:
+            out.append(f"</{in_list}>")
+            in_list = None
+
+    def close_table():
+        nonlocal in_table
+        if in_table:
+            out.append("</table>")
+            in_table = False
+
+    while i < len(lines):
+        line = lines[i]
+        if line.startswith("```"):
+            close_list()
+            close_table()
+            out.append("<pre><code>" if not in_code else "</code></pre>")
+            in_code = not in_code
+        elif in_code:
+            out.append(html.escape(line))
+        elif re.match(r"^#{1,6} ", line):
+            close_list()
+            close_table()
+            level = len(line) - len(line.lstrip("#"))
+            out.append(f"<h{level}>{_inline(line[level + 1:])}</h{level}>")
+        elif re.match(r"^\s*\|.*\|\s*$", line):
+            close_list()
+            if re.match(r"^\s*\|[\s\-:|]+\|\s*$", line):  # separator row
+                i += 1
+                continue
+            cells = [c.strip() for c in line.strip().strip("|").split("|")]
+            tag = "th" if not in_table else "td"
+            if not in_table:
+                out.append("<table>")
+                in_table = True
+            out.append("<tr>" + "".join(
+                f"<{tag}>{_inline(c)}</{tag}>" for c in cells) + "</tr>")
+        elif re.match(r"^\s*[-*] ", line):
+            close_table()
+            if in_list != "ul":
+                close_list()
+                out.append("<ul>")
+                in_list = "ul"
+            item = re.sub(r"^\s*[-*] ", "", line)
+            out.append(f"<li>{_inline(item)}</li>")
+        elif re.match(r"^\s*\d+\. ", line):
+            close_table()
+            if in_list != "ol":
+                close_list()
+                out.append("<ol>")
+                in_list = "ol"
+            item = re.sub(r"^\s*\d+\. ", "", line)
+            out.append(f"<li>{_inline(item)}</li>")
+        elif line.startswith(">"):
+            close_list()
+            close_table()
+            out.append(f"<blockquote>{_inline(line[1:].strip())}</blockquote>")
+        elif not line.strip():
+            close_list()
+            close_table()
+        else:
+            close_list()
+            close_table()
+            out.append(f"<p>{_inline(line)}</p>")
+        i += 1
+    close_list()
+    close_table()
+    return "\n".join(out)
+
+
+def check_links() -> int:
+    """Every relative .md link in every page must resolve.  Returns the
+    number of dead links (CI gate)."""
+    dead = 0
+    for page, _ in PAGES:
+        path = os.path.join(DOCS, page)
+        if not os.path.exists(path):
+            print(f"MISSING PAGE {page}")
+            dead += 1
+            continue
+        src = open(path, encoding="utf-8").read()
+        for m in re.finditer(r"\]\(([^)\s#]+\.md)", src):
+            target = os.path.normpath(
+                os.path.join(os.path.dirname(path), m.group(1)))
+            if not os.path.exists(target):
+                print(f"{page}: dead link → {m.group(1)}")
+                dead += 1
+    return dead
+
+
+def main() -> int:
+    if "--api" in sys.argv:
+        import subprocess
+
+        subprocess.run([sys.executable, os.path.join(DOCS, "gen_api.py")],
+                       check=True)
+    dead = check_links()
+    if "--check" in sys.argv:
+        print(f"link check: {dead} dead link(s)")
+        return 1 if dead else 0
+    os.makedirs(OUT, exist_ok=True)
+    nav = "<nav>" + "".join(
+        f'<a href="{os.path.basename(p).replace(".md", ".html")}">{t}</a>'
+        for p, t in PAGES) + "</nav>"
+    for page, title in PAGES:
+        path = os.path.join(DOCS, page)
+        if not os.path.exists(path):
+            continue
+        body = render(open(path, encoding="utf-8").read())
+        name = os.path.basename(page).replace(".md", ".html")
+        with open(os.path.join(OUT, name), "w", encoding="utf-8") as f:
+            f.write(f"<!doctype html><html><head><meta charset='utf-8'>"
+                    f"<title>raft_tpu — {title}</title>"
+                    f"<style>{_CSS}</style></head><body>{nav}{body}"
+                    f"</body></html>")
+    # README.html doubles as the landing page
+    readme = os.path.join(OUT, "README.html")
+    if os.path.exists(readme):
+        with open(readme, encoding="utf-8") as f:
+            content = f.read()
+        with open(os.path.join(OUT, "index.html"), "w",
+                  encoding="utf-8") as f:
+            f.write(content)
+    print(f"wrote {len(PAGES)} pages → {os.path.relpath(OUT)}; "
+          f"{dead} dead link(s)")
+    return 1 if dead else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

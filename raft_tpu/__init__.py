@@ -33,3 +33,5 @@ def __getattr__(name):
         globals()[name] = mod
         return mod
     raise AttributeError(f"module 'raft_tpu' has no attribute {name!r}")
+
+__all__ = ["core", "Resources", "DeviceResources", "default_resources"]

@@ -21,7 +21,7 @@ from __future__ import annotations
 import dataclasses
 import functools as _functools
 from functools import partial
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

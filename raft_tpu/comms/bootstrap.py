@@ -14,7 +14,6 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 import jax
-import numpy as np
 
 from ..core import resources as res_mod
 from ..core.mesh import make_mesh

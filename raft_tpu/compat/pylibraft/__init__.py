@@ -8,3 +8,5 @@ have no TPU meaning and are represented by host/device-array equivalents.
 from . import common, config, distance, neighbors, random, sparse  # noqa: F401
 
 __version__ = "26.08.00+tpu"
+
+__all__ = ["common", "config", "distance", "neighbors", "random", "sparse"]

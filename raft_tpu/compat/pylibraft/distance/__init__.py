@@ -3,7 +3,6 @@ README now delegates — ``README.md:96-119``)."""
 
 from __future__ import annotations
 
-import numpy as np
 
 __all__ = ["pairwise_distance", "DISTANCE_TYPES"]
 

@@ -18,3 +18,5 @@ it is accepted for signature parity and unused).
 
 from . import brute_force, cagra, ivf_flat, ivf_pq  # noqa: F401
 from .refine import refine  # noqa: F401
+
+__all__ = ["brute_force", "cagra", "ivf_flat", "ivf_pq", "refine"]

@@ -1,3 +1,5 @@
 """``pylibraft.sparse`` parity."""
 
 from . import linalg  # noqa: F401
+
+__all__ = ["linalg"]

@@ -15,7 +15,9 @@ from .resources import (
     get_comms,
     set_comms,
     get_workspace_limit,
+    get_host_pool,
 )
+from .host_memory import HostBufferPool, default_host_pool
 from .mesh import (make_mesh, make_1d_mesh, make_hybrid_mesh, local_mesh,
                    distributed_init, DATA_AXIS, SHARD_AXIS)
 from .array import wrap_array, check_rank, check_same_shape, check_dtype, to_numpy
@@ -38,11 +40,15 @@ __all__ = [
     "RaftError", "LogicError", "expects", "fail",
     "Resources", "DeviceResources", "default_resources", "set_default_resources",
     "get_mesh", "get_devices", "get_rng_key", "get_comms", "set_comms", "get_workspace_limit",
+    "get_host_pool", "HostBufferPool", "default_host_pool",
     "make_mesh", "make_1d_mesh", "make_hybrid_mesh", "local_mesh",
     "distributed_init", "DATA_AXIS", "SHARD_AXIS",
     "wrap_array", "check_rank", "check_same_shape", "check_dtype", "to_numpy",
     "copy",
     "Bitset", "Bitmap", "popc",
+    "MDBuffer", "memory_type", "memory_type_dispatcher",
+    "MemoryTracker", "analyze_memory", "device_memory_stats", "live_bytes",
+    "DeviceResourcesManager", "get_device_resources",
     "serialize_mdspan", "deserialize_mdspan", "serialize_scalar", "deserialize_scalar",
     "save_arrays", "load_arrays",
     "interruptible", "tracing", "logging",

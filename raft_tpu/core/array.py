@@ -10,7 +10,7 @@ rank/dtype.  Output conversion (``auto_convert_output`` parity,
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple, Union
+from typing import Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp

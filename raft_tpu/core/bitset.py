@@ -10,7 +10,6 @@ for sample filtering in ANN search.
 
 from __future__ import annotations
 
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp

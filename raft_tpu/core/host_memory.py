@@ -1,0 +1,121 @@
+"""Host staging memory — the host/pinned memory-resource role of the
+reference's MR stack (``mr/host/*`` resources and the pinned container
+policies of ``core/host_mdarray.hpp``; accounting counterpart in
+:mod:`raft_tpu.core.memory`).
+
+TPU translation: PJRT owns the device allocator *and* the pinned staging
+under ``device_put`` — a Python framework cannot (and should not) manage
+device pages.  What it can own is the host side of every transfer: the
+numpy buffers that disk readers fill and ``device_put`` drains.  Steady-
+state streaming (out-of-core builds, ``io.BatchLoader``) re-reads
+same-shaped chunks thousands of times; allocating a fresh multi-hundred-MB
+array per chunk costs page faults + zeroing and defeats the OS page-cache
+warmth that makes the native reader fast.  :class:`HostBufferPool` is the
+pinned-pool analog: shape/dtype-keyed reuse of staging buffers with a byte
+bound, so the hot loop allocates nothing after the first lap.
+
+Safety contract: a pooled buffer returned by :meth:`HostBufferPool.acquire`
+is exclusively the caller's until :meth:`~HostBufferPool.release`; consumers
+of APIs that *lend* pooled buffers (``BatchLoader(reuse_buffers=True)``)
+must treat each batch as valid only until the next iteration — exactly the
+lifetime a double-buffered pinned staging ring gives in the reference.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+__all__ = ["HostBufferPool", "default_host_pool"]
+
+
+class HostBufferPool:
+    """Shape/dtype-keyed free-list of host staging buffers.
+
+    ``limit_bytes`` bounds the *idle* bytes held in free lists (buffers out
+    on loan are the caller's problem); releases past the bound simply drop
+    the buffer.  Thread-safe — readers release from worker threads.
+
+    >>> pool = HostBufferPool()
+    >>> a = pool.acquire((4, 3), np.float32)
+    >>> pool.release(a)
+    >>> b = pool.acquire((4, 3), np.float32)
+    >>> b is a  # steady state allocates nothing
+    True
+    >>> pool.stats()["hits"], pool.stats()["misses"]
+    (1, 1)
+    """
+
+    def __init__(self, limit_bytes: int = 1 << 31):
+        self._lock = threading.Lock()
+        self._free: Dict[Tuple[Tuple[int, ...], str], List[np.ndarray]] = {}
+        self._limit = int(limit_bytes)
+        self._held = 0
+        self._hits = 0
+        self._misses = 0
+
+    @staticmethod
+    def _key(shape, dtype):
+        return (tuple(int(s) for s in shape), np.dtype(dtype).str)
+
+    def acquire(self, shape, dtype) -> np.ndarray:
+        """A C-contiguous buffer of exactly ``(shape, dtype)`` — reused when
+        a matching one is free, freshly allocated otherwise.  Contents are
+        undefined (the caller fills it)."""
+        key = self._key(shape, dtype)
+        with self._lock:
+            lst = self._free.get(key)
+            if lst:
+                buf = lst.pop()
+                self._held -= buf.nbytes
+                self._hits += 1
+                return buf
+            self._misses += 1
+        return np.empty(key[0], dtype=np.dtype(key[1]))
+
+    def release(self, buf: np.ndarray) -> None:
+        """Return a buffer to the pool (dropped when over ``limit_bytes`` or
+        not a plain C-contiguous array we could hand out again)."""
+        if not isinstance(buf, np.ndarray) or not buf.flags.c_contiguous \
+                or buf.base is not None:
+            return
+        key = self._key(buf.shape, buf.dtype)
+        with self._lock:
+            if self._held + buf.nbytes > self._limit:
+                return
+            self._free.setdefault(key, []).append(buf)
+            self._held += buf.nbytes
+
+    @contextlib.contextmanager
+    def borrow(self, shape, dtype):
+        """``with pool.borrow((n, d), np.float32) as buf: …`` — scoped
+        acquire/release."""
+        buf = self.acquire(shape, dtype)
+        try:
+            yield buf
+        finally:
+            self.release(buf)
+
+    def trim(self) -> None:
+        """Drop every idle buffer (e.g. before a big device allocation)."""
+        with self._lock:
+            self._free.clear()
+            self._held = 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"hits": self._hits, "misses": self._misses,
+                    "held_bytes": self._held,
+                    "free_buffers": sum(map(len, self._free.values()))}
+
+
+def default_host_pool(res=None) -> HostBufferPool:
+    """The process-default pool, one lazy cell on ``Resources``
+    (``resource_types.hpp`` slot parity — see
+    :data:`raft_tpu.core.resources.Resources.HOST_POOL`)."""
+    from .resources import Resources, _resolve
+
+    return _resolve(res).get_resource(Resources.HOST_POOL)

@@ -67,6 +67,7 @@ class Resources:
     LOGGER = "logger"
     DEFAULT_DTYPE = "default_dtype"
     DONATE = "donate"
+    HOST_POOL = "host_pool"
 
     def __init__(self, **overrides: Any) -> None:
         self._lock = threading.RLock()
@@ -120,6 +121,7 @@ class Resources:
         self.add_resource_factory(self.WORKSPACE_LIMIT, lambda _res: None)
         self.add_resource_factory(self.DEFAULT_DTYPE, lambda _res: np.float32)
         self.add_resource_factory(self.DONATE, lambda _res: False)
+        self.add_resource_factory(self.HOST_POOL, _default_host_pool_factory)
         self.add_resource_factory(self.LOGGER, _default_logger_factory)
 
     # -- convenience properties -------------------------------------------
@@ -185,6 +187,12 @@ def _default_logger_factory(_res: Resources):
     from . import logging as raft_logging
 
     return raft_logging.default_logger()
+
+
+def _default_host_pool_factory(_res: Resources):
+    from .host_memory import HostBufferPool
+
+    return HostBufferPool()
 
 
 class DeviceResources(Resources):
@@ -264,3 +272,9 @@ def set_comms(res: Resources, comms) -> None:
 
 def get_workspace_limit(res: Optional[Resources] = None) -> Optional[int]:
     return _resolve(res).get_resource(Resources.WORKSPACE_LIMIT)
+
+
+def get_host_pool(res: Optional[Resources] = None):
+    """The host staging-buffer pool (pinned-MR analog —
+    :mod:`raft_tpu.core.host_memory`)."""
+    return _resolve(res).get_resource(Resources.HOST_POOL)

@@ -11,7 +11,6 @@ encoding (``serialize_scalar``).  Index objects serialize as a directory of
 
 from __future__ import annotations
 
-import io
 import json
 import os
 from typing import Any, BinaryIO, Dict, Union

@@ -32,12 +32,19 @@ _VECS_DTYPES = {".fvecs": (np.float32, 4), ".bvecs": (np.uint8, 1),
                 ".ivecs": (np.int32, 4)}
 
 
-def read_npy(path: str, *, mmap: bool = False, threads: int = 8) -> np.ndarray:
+def read_npy(path: str, *, mmap: bool = False, threads: int = 8,
+             out: Optional[np.ndarray] = None) -> np.ndarray:
     """Load a ``.npy`` file.  ``mmap=True`` returns a zero-copy
     memory-mapped view; otherwise the data section is read with the
     native threaded reader when available (several GB/s from page cache
-    vs. single-stream ``np.load``)."""
+    vs. single-stream ``np.load``).
+
+    ``out``: optional preallocated destination (e.g. from
+    ``core.HostBufferPool`` — the pinned staging-reuse pattern); shape,
+    dtype, and memory order must match the file exactly."""
     if mmap:
+        if out is not None:
+            raise ValueError("out= and mmap=True are mutually exclusive")
         return np.load(path, mmap_mode="r", allow_pickle=False)
     try:
         # files the C parser can't express (structured dtypes, ndim > 8)
@@ -46,7 +53,14 @@ def read_npy(path: str, *, mmap: bool = False, threads: int = 8) -> np.ndarray:
     except OSError:
         hdr = None
     if hdr is None:
-        return np.load(path, allow_pickle=False)
+        data = np.load(path, allow_pickle=False)
+        if out is None:
+            return data
+        if out.shape != data.shape or out.dtype != data.dtype:
+            raise ValueError(f"out {out.shape}/{out.dtype} does not match "
+                             f"file {data.shape}/{data.dtype}")
+        np.copyto(out, data)
+        return out
     descr, shape, fortran, offset = hdr
     dt = np.dtype(descr)
     if dt.hasobject:
@@ -54,9 +68,20 @@ def read_npy(path: str, *, mmap: bool = False, threads: int = 8) -> np.ndarray:
         # PyObject* array from disk would segfault; np.load raises the
         # proper allow_pickle error instead
         return np.load(path, allow_pickle=False)
-    out = np.empty(shape, dtype=dt, order="F" if fortran else "C")
+    if out is not None:
+        want_order = "F" if fortran else "C"
+        ok = (out.shape == tuple(shape) and out.dtype == dt
+              and (out.flags.f_contiguous if fortran
+                   else out.flags.c_contiguous))
+        if not ok:
+            raise ValueError(f"out must be {want_order}-contiguous "
+                             f"{tuple(shape)}/{dt}, got "
+                             f"{out.shape}/{out.dtype}")
+    else:
+        out = np.empty(shape, dtype=dt, order="F" if fortran else "C")
     if not native.pread_dense_into(path, offset, out, threads=threads):
-        return np.load(path, allow_pickle=False)
+        data = np.load(path, allow_pickle=False)
+        np.copyto(out, data)
     return out
 
 
@@ -82,14 +107,21 @@ def _vecs_meta(path: str):
 
 
 def _read_vecs(path: str, start: int, count: Optional[int], threads: int,
-               geometry: Optional[Tuple[int, int]] = None) -> np.ndarray:
+               geometry: Optional[Tuple[int, int]] = None,
+               out: Optional[np.ndarray] = None) -> np.ndarray:
     dt, esz = _vecs_meta(path)
     rows, dim = geometry if geometry is not None else vecs_shape(path)
     if count is None:
         count = rows - start
     if start < 0 or start + count > rows:
         raise ValueError(f"rows [{start}, {start + count}) out of range {rows}")
-    out = np.empty((count, dim), dtype=dt)
+    if out is not None:
+        if out.shape != (count, dim) or out.dtype != dt \
+                or not out.flags.c_contiguous:
+            raise ValueError(f"out must be C-contiguous ({count}, {dim})/"
+                             f"{np.dtype(dt)}, got {out.shape}/{out.dtype}")
+    else:
+        out = np.empty((count, dim), dtype=dt)
     if native.available() and native.vecs_read_into(
             path, esz, dim, start, count, out, threads=threads):
         return out
@@ -97,7 +129,8 @@ def _read_vecs(path: str, start: int, count: Optional[int], threads: int,
     raw = np.memmap(path, dtype=np.uint8, mode="r",
                     offset=start * row_bytes, shape=(count * row_bytes,))
     mat = raw.reshape(count, row_bytes)[:, 4:]
-    return mat.view(dt).reshape(count, dim).copy()
+    np.copyto(out, mat.view(dt).reshape(count, dim))
+    return out
 
 
 def read_fvecs(path: str, start: int = 0, count: Optional[int] = None,
@@ -122,16 +155,29 @@ class BatchLoader:
     """Double-buffered background batch reader: while the TPU consumes
     batch *i*, a worker thread reads batch *i+1* (native threaded pread
     underneath).  The host-side analog of the reference's stream-pool
-    copy/compute overlap (``core/resource/cuda_stream_pool.hpp``)."""
+    copy/compute overlap (``core/resource/cuda_stream_pool.hpp``).
+
+    ``reuse_buffers=True`` stages batches through the host pool
+    (``core.HostBufferPool``, the pinned-MR analog): the steady-state
+    loop allocates nothing, cycling two staging buffers.  The contract
+    is the standard staging-ring one: **each yielded batch is valid only
+    until the next iteration** — copy it (or finish converting it to a
+    device array) before advancing."""
 
     def __init__(self, path: str, batch_rows: int, *, start: int = 0,
-                 stop: Optional[int] = None, threads: int = 8):
+                 stop: Optional[int] = None, threads: int = 8,
+                 reuse_buffers: bool = False, host_pool=None):
         self._path = path
         self._batch = int(batch_rows)
         self._rows, self._dim = vecs_shape(path)
         self._stop = self._rows if stop is None else min(stop, self._rows)
         self._start = start
         self._threads = threads
+        self._pool = None
+        if reuse_buffers:
+            from ..core.host_memory import default_host_pool
+
+            self._pool = host_pool or default_host_pool()
 
     @property
     def dim(self) -> int:
@@ -143,20 +189,37 @@ class BatchLoader:
     def __iter__(self) -> Iterator[np.ndarray]:
         import concurrent.futures as cf
 
-        with cf.ThreadPoolExecutor(max_workers=1) as pool:
+        dt, _ = _vecs_meta(self._path)
+
+        def submit(workers, lo, n):
+            buf = (self._pool.acquire((n, self._dim), dt)
+                   if self._pool is not None else None)
+            return workers.submit(_read_vecs, self._path, lo, n,
+                                  self._threads, geom, buf)
+
+        with cf.ThreadPoolExecutor(max_workers=1) as workers:
             nxt = None
+            prev = None
             geom = (self._rows, self._dim)
             for lo in range(self._start, self._stop, self._batch):
                 n = min(self._batch, self._stop - lo)
                 if nxt is None:
-                    nxt = pool.submit(_read_vecs, self._path, lo, n,
-                                      self._threads, geom)
+                    nxt = submit(workers, lo, n)
                 cur = nxt.result()
+                if prev is not None and self._pool is not None:
+                    # the consumer advanced past ``prev`` (the lending
+                    # contract) and the worker is idle here — releasing
+                    # before the next submit closes the two-buffer ring:
+                    # the worker refills ``prev`` while the consumer
+                    # holds ``cur``
+                    self._pool.release(prev)
                 hi = lo + self._batch
                 if hi < self._stop:
                     nn = min(self._batch, self._stop - hi)
-                    nxt = pool.submit(_read_vecs, self._path, hi, nn,
-                                      self._threads, geom)
+                    nxt = submit(workers, hi, nn)
                 else:
                     nxt = None
+                prev = cur
                 yield cur
+            if prev is not None and self._pool is not None:
+                self._pool.release(prev)

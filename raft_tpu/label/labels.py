@@ -7,7 +7,7 @@ union of two labellings — the CUDA kernel loop becomes pointer-jumping gathers
 
 from __future__ import annotations
 
-from typing import Callable, Optional, Tuple
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp

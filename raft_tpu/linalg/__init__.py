@@ -29,3 +29,16 @@ from .pca import (
     PcaSolver, PcaParams, PcaModel, pca_fit, pca_transform,
     pca_fit_transform, pca_inverse_transform,
 )
+
+__all__ = ["map", "map_offset", "unary_op", "binary_op", "ternary_op", "add",
+    "add_scalar", "subtract", "subtract_scalar", "multiply", "multiply_scalar",
+    "divide", "divide_scalar", "power", "power_scalar", "sqrt", "Apply",
+    "reduce", "coalesced_reduction", "strided_reduction", "map_reduce",
+    "reduce_rows_by_key", "reduce_cols_by_key", "mean_squared_error",
+    "NormType", "norm", "row_norm", "col_norm", "normalize", "row_normalize",
+    "matrix_vector_op", "binary_mult_skip_zero", "binary_div_skip_zero",
+    "gemm", "gemv", "axpy", "dot", "transpose", "init_eye", "eig_dc",
+    "eig_dc_selective", "eig_jacobi", "qr_get_q", "qr_get_qr", "svd_qr",
+    "svd_eig", "svd_jacobi", "rsvd_fixed_rank", "lstsq_svd_qr", "lstsq_eig",
+    "lstsq_qr", "cholesky_r1_update", "PcaSolver", "PcaParams", "PcaModel",
+    "pca_fit", "pca_transform", "pca_fit_transform", "pca_inverse_transform"]

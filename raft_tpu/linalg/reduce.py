@@ -14,7 +14,7 @@ On TPU a reduction lowers to an XLA ``reduce`` the compiler tiles onto the VPU
 from __future__ import annotations
 
 import enum
-from typing import Callable, Optional
+from typing import Callable
 
 import jax
 import jax.numpy as jnp

@@ -12,3 +12,9 @@ from .ops import (
     threshold, lower_triangular, upper_triangular, ratio, reciprocal,
     eye, fill,
 )
+
+__all__ = ["SelectAlgo", "select_k", "gather", "gather_if", "scatter",
+    "argmax", "argmin", "col_wise_sort", "sample_rows", "get_diagonal",
+    "set_diagonal", "invert_diagonal", "linewise_op", "reverse", "sign_flip",
+    "slice", "shift_rows", "threshold", "lower_triangular", "upper_triangular",
+    "ratio", "reciprocal", "eye", "fill"]

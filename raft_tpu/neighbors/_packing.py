@@ -15,7 +15,7 @@ replacement for CUDA atomic-append list construction.
 from __future__ import annotations
 
 from functools import partial
-from typing import Sequence, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

@@ -14,3 +14,10 @@ from .rng import (
 )
 from .datagen import make_blobs, make_regression, multi_variable_gaussian, permute
 from .rmat import rmat_rectangular_gen, rmat
+
+__all__ = ["GeneratorType", "RngState", "uniform", "uniform_int", "normal",
+    "normal_int", "normal_table", "fill", "bernoulli", "scaled_bernoulli",
+    "gumbel", "lognormal", "logistic", "exponential", "rayleigh", "laplace",
+    "discrete", "sample_without_replacement", "excess_subsample", "make_blobs",
+    "make_regression", "multi_variable_gaussian", "permute",
+    "rmat_rectangular_gen", "rmat"]

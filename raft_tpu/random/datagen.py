@@ -11,7 +11,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.array import wrap_array
-from .rng import RngState, _key_of
+from .rng import _key_of
 
 __all__ = ["make_blobs", "make_regression", "multi_variable_gaussian", "permute"]
 

@@ -12,7 +12,7 @@ uniform tensor drives everything.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

@@ -10,7 +10,6 @@ default threefry-family generator; the PCG option maps to ``rbg`` when needed.
 from __future__ import annotations
 
 import enum
-from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -16,7 +16,6 @@ sparse library; the idiomatic formulations are:
 
 from __future__ import annotations
 
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -9,7 +9,7 @@ returned.  Host-eager callers get exact-size results via ``.trimmed()``.
 
 from __future__ import annotations
 
-from typing import Callable, Tuple
+from typing import Callable
 
 import jax
 import jax.numpy as jnp

@@ -7,7 +7,6 @@ values = raw term counts.
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 
 from .linalg import csr_row_norm

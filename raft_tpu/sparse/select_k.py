@@ -9,7 +9,7 @@ is the layout the hardware wants anyway.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

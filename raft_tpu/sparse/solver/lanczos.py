@@ -22,12 +22,10 @@ TPU redesign notes:
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
 from typing import Callable, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ...core.errors import expects
 from ..linalg import spmv

@@ -17,7 +17,7 @@ gemm-dominated).
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple, Union
+from typing import Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -76,8 +76,8 @@ def randomized_svds(
         a = coo_to_csr(a)
     m, n = a.shape
     k = config.k
-    l = min(k + config.p, min(m, n))
-    expects(k <= l, "k + oversampling must fit the matrix")
+    sk = min(k + config.p, min(m, n))
+    expects(k <= sk, "k + oversampling must fit the matrix")
     dtype = a.data.dtype
 
     from ..linalg import csr_transpose
@@ -85,19 +85,19 @@ def randomized_svds(
     at = csr_transpose(a)
 
     key = jax.random.PRNGKey(config.seed)
-    omega = jax.random.normal(key, (n, l), dtype)
+    omega = jax.random.normal(key, (n, sk), dtype)
 
-    y = spmm(a, omega)  # [m, l] sketch
+    y = spmm(a, omega)  # [m, sk] sketch
     q = _cholesky_qr2(y)
     for _ in range(config.n_iters):
-        z = spmm(at, q)  # [n, l]
+        z = spmm(at, q)  # [n, sk]
         z = _cholesky_qr2(z)
         y = spmm(a, z)
         q = _cholesky_qr2(y)
 
-    b = spmm(at, q).T  # [l, n] projected matrix B = Q^T A
+    b = spmm(at, q).T  # [sk, n] projected matrix B = Q^T A
     ub, s, vt = jnp.linalg.svd(b, full_matrices=False)
-    u = q @ ub  # [m, l]
+    u = q @ ub  # [m, sk]
     u, s, v = u[:, :k], s[:k], vt[:k].T
     if config.sign_correction:
         u, v = _sign_correct(u, v)

@@ -15,3 +15,12 @@ from .clustering import (
     silhouette_score, IC_Type, information_criterion_batched,
 )
 from .neighborhood import neighborhood_recall, trustworthiness_score
+
+__all__ = ["mean", "stddev", "sum", "meanvar", "mean_center", "mean_add",
+    "minmax", "cov", "weighted_mean", "row_weighted_mean", "col_weighted_mean",
+    "histogram", "dispersion", "accuracy", "r2_score", "RegressionMetrics",
+    "regression_metrics", "contingency_matrix", "adjusted_rand_index",
+    "rand_index", "mutual_info_score", "entropy", "homogeneity_score",
+    "completeness_score", "v_measure", "kl_divergence", "silhouette_score",
+    "IC_Type", "information_criterion_batched", "neighborhood_recall",
+    "trustworthiness_score"]

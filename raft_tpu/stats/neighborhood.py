@@ -6,7 +6,6 @@ QPS@recall target).
 
 from __future__ import annotations
 
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -10,7 +10,6 @@ search merges exactly).  Reference analog: the SNMG build model,
 ``/root/reference/cpp/include/raft/core/device_resources_snmg.hpp:36-154``.
 """
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

@@ -1,0 +1,139 @@
+"""Host staging-buffer pool (core.host_memory — the pinned/host-MR analog,
+SURVEY §2.1 #17) and its IO integrations (read_npy/read_*vecs ``out=``,
+``BatchLoader(reuse_buffers=True)``)."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from raft_tpu.core.host_memory import HostBufferPool, default_host_pool
+from raft_tpu import io
+
+
+class TestHostBufferPool:
+    def test_reuse_identity(self):
+        pool = HostBufferPool()
+        a = pool.acquire((8, 4), np.float32)
+        pool.release(a)
+        assert pool.acquire((8, 4), np.float32) is a
+
+    def test_shape_dtype_keying(self):
+        pool = HostBufferPool()
+        a = pool.acquire((8, 4), np.float32)
+        pool.release(a)
+        assert pool.acquire((8, 4), np.int32) is not a
+        assert pool.acquire((4, 8), np.float32) is not a
+
+    def test_limit_drops_over_budget(self):
+        pool = HostBufferPool(limit_bytes=100)
+        big = pool.acquire((1000,), np.float64)  # 8 kB > limit
+        pool.release(big)
+        assert pool.stats()["held_bytes"] == 0
+        assert pool.acquire((1000,), np.float64) is not big
+
+    def test_release_rejects_views(self):
+        pool = HostBufferPool()
+        base = np.zeros((10, 10), np.float32)
+        pool.release(base[:5])  # a view — must not enter the pool
+        assert pool.stats()["free_buffers"] == 0
+
+    def test_borrow_scope(self):
+        pool = HostBufferPool()
+        with pool.borrow((4,), np.float32) as buf:
+            buf[:] = 7
+        assert pool.stats()["free_buffers"] == 1
+        assert pool.acquire((4,), np.float32) is buf
+
+    def test_trim(self):
+        pool = HostBufferPool()
+        pool.release(pool.acquire((4,), np.float32))
+        pool.trim()
+        assert pool.stats() == {"hits": 0, "misses": 1, "held_bytes": 0,
+                                "free_buffers": 0}
+
+    def test_thread_safety(self):
+        pool = HostBufferPool()
+        errs = []
+
+        def worker():
+            try:
+                for _ in range(200):
+                    b = pool.acquire((16,), np.float32)
+                    pool.release(b)
+            except Exception as e:  # noqa: BLE001 — collected for assert
+                errs.append(e)
+
+        ts = [threading.Thread(target=worker) for _ in range(4)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert not errs
+
+    def test_default_pool_is_a_resource_cell(self):
+        from raft_tpu.core.resources import Resources, get_host_pool
+
+        res = Resources()
+        assert get_host_pool(res) is get_host_pool(res)  # lazy, then shared
+        assert isinstance(default_host_pool(res), HostBufferPool)
+
+
+@pytest.fixture()
+def npy_file(tmp_path, rng):
+    x = rng.standard_normal((64, 16)).astype(np.float32)
+    p = os.path.join(tmp_path, "x.npy")
+    np.save(p, x)
+    return p, x
+
+
+@pytest.fixture()
+def fvecs_file(tmp_path, rng):
+    x = rng.standard_normal((40, 8)).astype(np.float32)
+    p = os.path.join(tmp_path, "x.fvecs")
+    with open(p, "wb") as f:
+        for row in x:
+            np.int32(8).tofile(f)
+            row.tofile(f)
+    return p, x
+
+
+class TestIoOut:
+    def test_read_npy_into_buffer(self, npy_file):
+        p, x = npy_file
+        buf = np.empty((64, 16), np.float32)
+        got = io.read_npy(p, out=buf)
+        assert got is buf
+        np.testing.assert_array_equal(buf, x)
+
+    def test_read_npy_out_mismatch_raises(self, npy_file):
+        p, _ = npy_file
+        with pytest.raises(ValueError, match="out"):
+            io.read_npy(p, out=np.empty((64, 16), np.float64))
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            io.read_npy(p, mmap=True, out=np.empty((64, 16), np.float32))
+
+    def test_read_fvecs_into_buffer(self, fvecs_file):
+        p, x = fvecs_file
+        buf = np.empty((10, 8), np.float32)
+        got = io._read_vecs(p, 5, 10, 2, out=buf)
+        assert got is buf
+        np.testing.assert_array_equal(buf, x[5:15])
+
+    def test_batch_loader_reuse(self, fvecs_file):
+        p, x = fvecs_file
+        pool = HostBufferPool()
+        batches = []
+        for b in io.BatchLoader(p, 16, reuse_buffers=True, host_pool=pool):
+            batches.append(b.copy())  # the lending contract: copy to retain
+        np.testing.assert_array_equal(np.concatenate(batches), x)
+        # the ring really cycled: full batches came from <= 2 distinct
+        # buffers, and they are back in the pool afterwards
+        assert pool.stats()["misses"] <= 3  # 2 full-batch + 1 boundary shape
+        assert pool.stats()["free_buffers"] >= 1
+
+    def test_batch_loader_reuse_matches_fresh(self, fvecs_file):
+        p, x = fvecs_file
+        fresh = [b.copy() for b in io.BatchLoader(p, 16)]
+        reused = [b.copy() for b in io.BatchLoader(p, 16, reuse_buffers=True)]
+        for a, b in zip(fresh, reused):
+            np.testing.assert_array_equal(a, b)

@@ -2,9 +2,7 @@
 ``pylibraft/tests/test_random.py``: distribution moments, sampling invariants,
 blob separability, rmat bounds/skew."""
 
-import jax
 import numpy as np
-import pytest
 
 from raft_tpu import random as rnd
 from raft_tpu.random import RngState

@@ -3,7 +3,6 @@ eigsh against cupyx.scipy, ``pylibraft/tests/test_sparse.py``; SURVEY.md §4).""
 
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from raft_tpu.sparse import CSR, COO
 from raft_tpu.sparse.solver import eigsh, mst, svds
