@@ -92,7 +92,23 @@ def _bench_brute_force():
 
     from raft_tpu.stats import neighborhood_recall
 
-    fast = lambda: _fast_knn_impl(q, db, K, "sqeuclidean", 64, 1024, 1024)
+    # fast-path tuning knobs (A/B on hardware without code edits; the
+    # recall gate below still protects every combination)
+    cand = int(os.environ.get("RAFT_BENCH_CAND", 64))
+    bm = int(os.environ.get("RAFT_BENCH_BM", 1024))
+    bn = int(os.environ.get("RAFT_BENCH_BN", 1024))
+    cut = os.environ.get("RAFT_BENCH_CUT", "exact").lower()
+    prec = os.environ.get("RAFT_BENCH_REFINE_PREC", "highest").lower()
+    # a typo'd knob must fail the config loudly, not silently measure the
+    # default while labeled as the variant (_fast_knn_impl treats unknown
+    # strings as the defaults; only knn() carries the expects guard)
+    if cut not in ("exact", "approx"):
+        raise ValueError(f"RAFT_BENCH_CUT={cut!r} (want exact|approx)")
+    if prec not in ("highest", "high"):
+        raise ValueError(f"RAFT_BENCH_REFINE_PREC={prec!r} "
+                         f"(want highest|high)")
+    fast = lambda: _fast_knn_impl(q, db, K, "sqeuclidean", cand, bm, bn,
+                                  None, cut, prec)
     fi = np.asarray(fetch(fast())[1])  # compile + warm
     recall = float(neighborhood_recall(fi, gt_idx))
 
@@ -561,13 +577,14 @@ def main() -> None:
                 # SIGTERM between configs must never truncate the ratchet)
                 import datetime
 
-                hist["_meta"] = {"backend": state["backend"],
-                                 "date": datetime.date.today().isoformat(),
-                                 "protocol": PROTOCOL,
-                                 "rows": {"brute_force": N_DB,
-                                          "ivf_pq": PQ_ROWS,
-                                          "cagra": CAGRA_ROWS,
-                                          "ivf_flat": IF_ROWS}}
+                # per-backend stamp (the file accumulates bests across runs;
+                # a flat stamp would let a later run relabel another
+                # backend's numbers — the prims.py pattern)
+                hist.setdefault("_meta", {})[state["backend"]] = {
+                    "date": datetime.date.today().isoformat(),
+                    "protocol": PROTOCOL,
+                    "rows": {"brute_force": N_DB, "ivf_pq": PQ_ROWS,
+                             "cagra": CAGRA_ROWS, "ivf_flat": IF_ROWS}}
                 tmp = HISTORY + ".tmp"
                 with open(tmp, "w") as f:
                     json.dump(hist, f)

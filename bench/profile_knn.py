@@ -134,6 +134,19 @@ def main() -> None:
 
     guarded("refine_gather_rescore_64", lambda: rescore(short))
 
+    @jax.jit
+    def rescore_high(short):
+        # decision-tree branch 1: HIGHEST→HIGH (bf16x6 → bf16x3) on the
+        # refine einsum — measures what the first tuning step would buy
+        from raft_tpu.neighbors.brute_force import _exact_candidate_distances
+
+        dc = _exact_candidate_distances(q, db[short], "sqeuclidean",
+                                        precision=jax.lax.Precision.HIGH)
+        negv, p2 = jax.lax.top_k(-dc, k)
+        return -negv, jnp.take_along_axis(short, p2, axis=1)
+
+    guarded("refine_gather_rescore_64_high", lambda: rescore_high(short))
+
     # full fast path (current defaults) + RTT split
     from raft_tpu.neighbors.brute_force import _fast_knn_impl, _knn_impl
 

@@ -105,7 +105,8 @@ def render(md: str) -> str:
             if re.match(r"^\s*\|[\s\-:|]+\|\s*$", line):  # separator row
                 i += 1
                 continue
-            cells = [c.strip() for c in line.strip().strip("|").split("|")]
+            cells = [c.strip().replace("\\|", "|") for c in
+                     re.split(r"(?<!\\)\|", line.strip().strip("|"))]
             tag = "th" if not in_table else "td"
             if not in_table:
                 out.append("<table>")

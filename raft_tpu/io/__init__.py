@@ -174,7 +174,9 @@ class BatchLoader:
         self._start = start
         self._threads = threads
         self._pool = None
-        if reuse_buffers:
+        if reuse_buffers or host_pool is not None:
+            # an explicit pool IS the reuse request — silently ignoring it
+            # would allocate fresh buffers the caller thought were pooled
             from ..core.host_memory import default_host_pool
 
             self._pool = host_pool or default_host_pool()

@@ -129,13 +129,17 @@ def _knn_impl(x, y, k: int, metric: str, tile: int,
     return bv, bi
 
 
-def _exact_candidate_distances(x, yc, metric: str):
+def _exact_candidate_distances(x, yc, metric: str, precision=None):
     """Exact f32 metric between each query and its (cand,) gathered rows.
-    ``yc``: (m, cand, d)."""
+    ``yc``: (m, cand, d).  ``precision`` defaults to HIGHEST (bf16x6 MXU
+    passes); pass ``jax.lax.Precision.HIGH`` (bf16x3) to trade the last
+    ~0.5 ulp of the rescore for ~2× einsum throughput — the refine stage
+    re-ranks a shortlist whose gaps are usually ≫ bf16x3 error, so HIGH
+    is the first knob of the fast-path tuning tree (docs/perf_analysis.md)."""
     xf = x.astype(jnp.float32)
     ycf = yc.astype(jnp.float32)
     dots = jnp.einsum("md,mcd->mc", xf, ycf,
-                      precision=jax.lax.Precision.HIGHEST)
+                      precision=precision or jax.lax.Precision.HIGHEST)
     if metric == "inner_product":
         return _metric_from_dots(dots, None, None, metric)
     xn = jnp.sum(xf * xf, axis=1)
@@ -143,9 +147,11 @@ def _exact_candidate_distances(x, yc, metric: str):
     return _metric_from_dots(dots, xn, yn, metric)
 
 
-@partial(jax.jit, static_argnames=("k", "metric", "cand", "bm", "bn", "cut"))
+@partial(jax.jit, static_argnames=("k", "metric", "cand", "bm", "bn", "cut",
+                                   "refine_precision"))
 def _fast_knn_impl(x, y, k: int, metric: str, cand: int, bm: int, bn: int,
-                   keep=None, cut: str = "exact"):
+                   keep=None, cut: str = "exact",
+                   refine_precision: str = "highest"):
     """bf16 shortlist (fused Pallas kernel on TPU, XLA approx_max_k
     elsewhere) + exact f32 refine.  Smaller-is-nearer surrogate:
     ``‖y‖² − 2·x·yᵀ`` for L2/cosine-normalized data, ``−x·yᵀ`` for
@@ -241,7 +247,10 @@ def _fast_knn_impl(x, y, k: int, metric: str, cand: int, bm: int, bn: int,
 
         sel_sv, pos = select_k(sv, cand, select_min=True)
     short = jnp.take_along_axis(si, pos, axis=1)
-    dc = _exact_candidate_distances(x, y[short], metric)
+    dc = _exact_candidate_distances(
+        x, y[short], metric,
+        precision=(jax.lax.Precision.HIGH if refine_precision == "high"
+                   else jax.lax.Precision.HIGHEST))
     # shortlist slots that were never filled (inf sentinel, id clamped to 0)
     # must not be re-scored into fake neighbors
     dc = jnp.where(jnp.isfinite(sel_sv), dc, jnp.inf)
@@ -289,6 +298,7 @@ def knn(
     mode: str = "exact",
     cand: int = 64,
     cut: str = "exact",
+    refine_precision: str = "highest",
     filter=None,
     res=None,
 ) -> Tuple[jax.Array, jax.Array]:
@@ -299,7 +309,10 @@ def knn(
     module docstring).  ``cand`` is the fast-mode shortlist width
     (≥ 4·k recommended); ``cut`` picks the (m, shortlist)→cand
     reduction — ``"exact"`` (lax.top_k) or ``"approx"``
-    (``approx_max_k`` at recall_target 0.99, cheaper on TPU).
+    (``approx_max_k`` at recall_target 0.99, cheaper on TPU);
+    ``refine_precision`` ∈ {"highest", "high"} sets the rescore einsum's
+    MXU precision (bf16x6 vs ~2× faster bf16x3 — shortlist gaps usually
+    dwarf the extra error; see docs/perf_analysis.md).
 
     ``filter``: optional prefilter, True = keep (cuVS parity).  Either a
     shared row mask (``core.Bitset`` / (n,) bools — ``bitset_filter``) or
@@ -347,9 +360,11 @@ def knn(
                     "(%d) or mode='exact'",
                     max_excl, cand_eff, cand_eff - k, k,
                     min(k + max_excl, y.shape[0]))
+    expects(refine_precision in ("highest", "high"),
+            f"unknown refine_precision {refine_precision!r}")
     if mode == "fast":
         vals, ids = _fast_knn_impl(x, y, int(k), metric, int(max(cand, k)),
-                                   1024, 1024, keep, cut)
+                                   1024, 1024, keep, cut, refine_precision)
     else:
         vals, ids = _knn_impl(x, y, int(k), metric,
                               int(min(tile, max(y.shape[0], 1))), keep)

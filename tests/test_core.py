@@ -11,7 +11,8 @@ import pytest
 
 import raft_tpu
 from raft_tpu.core import (
-    Bitset, Bitmap, DeviceResources, LogicError, Resources, expects, interruptible, serialize_mdspan, save_arrays, load_arrays, wrap_array,
+    Bitset, Bitmap, DeviceResources, LogicError, Resources, expects,
+    interruptible, serialize_mdspan, save_arrays, load_arrays, wrap_array,
 )
 
 
