@@ -64,6 +64,11 @@ class CagraIndexParams:
     build_algo: str = "brute_force"  # brute_force | ivf
     n_routers: int = 128  # entry-point table size (see _build_routers)
     seed: int = 0
+    # accuracy of the intermediate kNN graph when build_algo="ivf": probes
+    # per point during graph construction.  The optimize step can only
+    # rank-merge edges the intermediate graph found, so this bounds final
+    # recall at scale (build time grows ~linearly with it)
+    build_n_probes: int = 16
 
 
 @dataclasses.dataclass(frozen=True)
@@ -198,6 +203,8 @@ def build(dataset, params: Optional[CagraIndexParams] = None, *,
     p = params or CagraIndexParams()
     x = wrap_array(dataset, ndim=2, name="dataset")
     n = x.shape[0]
+    expects(p.build_n_probes >= 1,
+            f"build_n_probes must be >= 1, got {p.build_n_probes}")
     kk = min(p.intermediate_graph_degree, n - 1)
     if p.build_algo == "ivf" and n >= 4096:
         from . import ivf_flat
@@ -205,8 +212,9 @@ def build(dataset, params: Optional[CagraIndexParams] = None, *,
         ip = ivf_flat.IvfFlatIndexParams(
             n_lists=max(16, int(np.sqrt(n))), metric=p.metric, seed=p.seed)
         index = ivf_flat.build(x, ip)
-        _, nbrs = ivf_flat.search(index, x, kk + 1,
-                                  ivf_flat.IvfFlatSearchParams(n_probes=16))
+        _, nbrs = ivf_flat.search(
+            index, x, kk + 1,
+            ivf_flat.IvfFlatSearchParams(n_probes=p.build_n_probes))
     else:
         from . import brute_force
 

@@ -62,6 +62,26 @@ def test_cagra_higher_effort_higher_recall(blob_data):
     assert _recall(high, want) > 0.85
 
 
+def test_cagra_ivf_build_n_probes(blob_data):
+    """build_n_probes steers the intermediate-graph accuracy of the IVF
+    build path; more probes must not degrade recall (quality lever for the
+    1M-scale gate)."""
+    x, q = blob_data
+    _, want = brute_force.knn(q, x, 10)
+    sp = cagra.CagraSearchParams(itopk_size=64, search_width=4)
+    recalls = []
+    for probes in (2, 24):
+        p = cagra.CagraIndexParams(intermediate_graph_degree=48,
+                                   graph_degree=24, build_algo="ivf",
+                                   build_n_probes=probes)
+        # the ivf path needs >= 4096 rows; blob_data is sized above that
+        assert x.shape[0] >= 4096
+        _, got = cagra.search(cagra.build(x, p), q, 10, sp)
+        recalls.append(_recall(got, want))
+    assert recalls[1] >= recalls[0] - 0.02  # never meaningfully worse
+    assert recalls[1] > 0.9
+
+
 def test_cagra_build_from_graph(blob_data):
     x, q = blob_data
     _, nbrs = brute_force.knn(x, x, 33)

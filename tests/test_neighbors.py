@@ -115,6 +115,20 @@ def test_knn_fast_mode_approx_cut(rng):
     assert float(neighborhood_recall(np.asarray(i), np.asarray(i_ref))) >= 0.95
 
 
+def test_knn_fast_mode_refine_precision(rng):
+    """refine_precision='high' (bf16x3 rescore) must keep the ranking on
+    clearly-separated data, and unknown values must be rejected."""
+    from raft_tpu.core.errors import LogicError
+
+    x = rng.standard_normal((10, 16)).astype(np.float32)
+    y = rng.standard_normal((400, 16)).astype(np.float32)
+    _, i_ref = knn(x, y, 5)
+    _, i = knn(x, y, 5, mode="fast", cand=64, refine_precision="high")
+    assert float(neighborhood_recall(np.asarray(i), np.asarray(i_ref))) >= 0.95
+    with pytest.raises(LogicError, match="refine_precision"):
+        knn(x, y, 5, mode="fast", refine_precision="medium")
+
+
 def test_knn_sharded_ring_matches_gather(rng, mesh8):
     x = rng.standard_normal((10, 8)).astype(np.float32)
     y = rng.standard_normal((160, 8)).astype(np.float32)
