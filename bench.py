@@ -114,16 +114,19 @@ def _bench_brute_force():
 
     if recall >= RECALL_GATE:
         run = fast
+        path = "fast"
     else:  # fall back to the exact path rather than report inflated QPS
         run = lambda: _knn_impl(q, db, K, "sqeuclidean", 65536)
         fetch(run())
         recall = 1.0  # the timed run is now the exact path
+        path = "exact"  # A/B selectors must not crown a fallen-back combo
 
     lat1 = single_latency(run)        # includes one tunnel round trip
     qps = measure_qps(run, N_QUERY, reps=REPS)
     per_call = N_QUERY / qps
     flops = 2.0 * N_QUERY * N_DB * DIM
     profile = {
+        "path": path,
         "single_dispatch_ms": round(lat1 * 1e3, 1),
         "pipelined_per_call_ms": round(per_call * 1e3, 1),
         "tunnel_overhead_ms": round((lat1 - per_call) * 1e3, 1),
@@ -321,10 +324,13 @@ print("PROBE_OK", jax.default_backend())
 # Timeout caps are generous; the budget guard, not these, bounds the normal
 # ladder — the caps only bound the damage of a mid-run tunnel wedge.
 _CONFIGS = (
+    # order = budget priority: headline first, then the ~30 s pairwise
+    # metric (cheap insurance before the big builds can eat a tight driver
+    # window), then the north-star index configs by importance
     ("brute_force", "brute_force_1Mx128", _bench_brute_force, None, None, 1500),
+    ("pairwise", "pairwise_10kx128", _bench_pairwise, 10_000, 1_000, 600),
     ("ivf_pq", "ivf_pq_deep10m_class", _bench_ivf_pq, PQ_ROWS, 100_000, 2700),
     ("cagra", "cagra_1m", _bench_cagra, CAGRA_ROWS, 100_000, 2100),
-    ("pairwise", "pairwise_10kx128", _bench_pairwise, 10_000, 1_000, 600),
     ("ivf_flat", "ivf_flat_kmeans_1m", _bench_ivf_flat_kmeans, IF_ROWS,
      100_000, 1800),
 )

@@ -34,9 +34,12 @@ OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 
 def main() -> None:
+    global OUT
     rows = 1_000_000
     if "--rows" in sys.argv:
         rows = int(sys.argv[sys.argv.index("--rows") + 1])
+    if "--out" in sys.argv:  # scaling probes must not clobber the artifact
+        OUT = sys.argv[sys.argv.index("--out") + 1]
     d, nq, k = 96, 2000, 10
     n_clusters = max(64, rows // 1000)
 

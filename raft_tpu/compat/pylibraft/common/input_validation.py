@@ -1,0 +1,72 @@
+"""``pylibraft.common.input_validation`` parity — array cross-checks.
+
+Upstream operates on ``__cuda_array_interface__`` dicts
+(``common/input_validation.py:13-63``); the TPU translation accepts
+anything with a shape/dtype (``jax.Array``, numpy, ``device_ndarray``)
+and reads the same facts through numpy semantics.  C-contiguity for a
+``jax.Array`` is definitionally true (XLA arrays export row-major).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["do_dtypes_match", "do_rows_match", "do_cols_match",
+           "do_shapes_match", "is_c_contiguous"]
+
+
+def _shape(a):
+    return tuple(a.shape)
+
+
+def _dtype(a):
+    return np.dtype(a.dtype).str
+
+
+def do_dtypes_match(*arrays) -> bool:
+    """True when every array shares one dtype.
+
+    >>> do_dtypes_match(np.zeros(2, np.float32), np.ones((3, 4), np.float32))
+    True
+    >>> do_dtypes_match(np.zeros(2, np.float32), np.zeros(2, np.int32))
+    False
+    """
+    return len({_dtype(a) for a in arrays}) == 1
+
+
+def do_rows_match(*arrays) -> bool:
+    """True when every array has the same leading dimension."""
+    return len({_shape(a)[0] for a in arrays}) == 1
+
+
+def do_cols_match(*arrays) -> bool:
+    """True when every array has the same second dimension."""
+    return len({_shape(a)[1] for a in arrays}) == 1
+
+
+def do_shapes_match(*arrays) -> bool:
+    """True when every array has exactly the same shape.
+
+    >>> do_shapes_match(np.zeros((2, 3)), np.ones((2, 3)))
+    True
+    """
+    return len({_shape(a) for a in arrays}) == 1
+
+
+def is_c_contiguous(a) -> bool:
+    """Row-major contiguity.  numpy answers from its flags; committed
+    ``jax.Array``s (and the compat ``device_ndarray``) are always exported
+    row-major, so anything without flags answers True.
+
+    >>> is_c_contiguous(np.zeros((4, 4)))
+    True
+    >>> is_c_contiguous(np.asfortranarray(np.zeros((4, 4))))
+    False
+    >>> is_c_contiguous(np.zeros((4, 1)))  # degenerate strides still count
+    True
+    """
+    flags = getattr(a, "flags", None)
+    if flags is not None:
+        return bool(flags["C_CONTIGUOUS"] if not hasattr(flags, "c_contiguous")
+                    else flags.c_contiguous)
+    return True

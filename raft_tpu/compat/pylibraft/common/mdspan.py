@@ -1,0 +1,40 @@
+"""``pylibraft.common.mdspan`` parity — the Python-callable surface of
+``common/mdspan.pyx``: the serializer roundtrip helper its tests use
+(``mdspan.pyx:40``).  The Cython view-construction plumbing has no TPU
+role (``jax.Array`` IS the view); serialization delegates to
+:mod:`raft_tpu.core.serialize` (the ``serialize.hpp`` analog, numpy
+``.npy`` framing on both sides).
+"""
+
+from __future__ import annotations
+
+import io
+
+import numpy as np
+
+from raft_tpu.core.serialize import deserialize_mdspan, serialize_mdspan
+
+__all__ = ["run_roundtrip_test_for_mdspan", "serialize_mdspan",
+           "deserialize_mdspan"]
+
+
+def run_roundtrip_test_for_mdspan(X, fortran_order: bool = False) -> None:
+    """Serialize ``X`` to the ``.npy`` wire format and back; raise unless
+    values, dtype, and memory order survive (upstream's roundtrip check).
+
+    >>> run_roundtrip_test_for_mdspan(np.arange(6, dtype=np.int32).reshape(2, 3))
+    >>> run_roundtrip_test_for_mdspan(
+    ...     np.asfortranarray(np.eye(3, dtype=np.float32)), fortran_order=True)
+    """
+    arr = np.asarray(X)
+    if fortran_order:
+        arr = np.asfortranarray(arr)
+    buf = io.BytesIO()
+    serialize_mdspan(buf, arr)
+    buf.seek(0)
+    back = deserialize_mdspan(buf)
+    np.testing.assert_array_equal(back, arr)
+    if back.dtype != arr.dtype:
+        raise AssertionError(f"dtype changed: {arr.dtype} -> {back.dtype}")
+    if fortran_order and not back.flags.f_contiguous:
+        raise AssertionError("fortran order not preserved")

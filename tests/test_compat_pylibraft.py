@@ -224,6 +224,30 @@ def test_neighbors_add_data_on_build_false_ivf_pq():
     assert (np.asarray(i)[:, 0] == np.arange(6)).all()
 
 
+def test_common_input_validation():
+    from raft_tpu.compat.pylibraft.common import input_validation as iv
+    import jax.numpy as jnp
+
+    a = np.zeros((4, 3), np.float32)
+    b = jnp.ones((4, 3), jnp.float32)
+    assert iv.do_dtypes_match(a, b) and iv.do_shapes_match(a, b)
+    assert iv.do_rows_match(a, b) and iv.do_cols_match(a, b)
+    assert not iv.do_dtypes_match(a, a.astype(np.int32))
+    assert not iv.do_rows_match(a, np.zeros((5, 3), np.float32))
+    assert iv.is_c_contiguous(a) and iv.is_c_contiguous(b)
+    assert not iv.is_c_contiguous(np.asfortranarray(np.zeros((4, 3))))
+
+
+def test_common_mdspan_roundtrip():
+    from raft_tpu.compat.pylibraft.common.mdspan import (
+        run_roundtrip_test_for_mdspan)
+
+    run_roundtrip_test_for_mdspan(
+        np.arange(12, dtype=np.float32).reshape(3, 4))
+    run_roundtrip_test_for_mdspan(
+        np.arange(12, dtype=np.int64).reshape(3, 4), fortran_order=True)
+
+
 def test_neighbors_out_params_filled():
     from raft_tpu.compat.pylibraft.neighbors import brute_force
 
