@@ -48,13 +48,15 @@ run_step() {
   local attempt
   for attempt in 1 2; do
     echo "$(date) start $name (attempt $attempt): $*" >> "$LOG/driver.log"
-    if timeout 1500 env "$@" python bench.py > "$LOG/$name.log" 2>&1 \
-        && measured "$LOG/$name.log"; then
+    timeout 1500 env "$@" python bench.py > "$LOG/$name.log" 2>&1
+    rc=$?
+    if [ "$rc" -eq 0 ] && measured "$LOG/$name.log"; then
       touch "$LOG/$name.done"
       echo "$(date) done $name" >> "$LOG/driver.log"
       return 0
     fi
-    echo "$(date) FAILED $name (rc=$?, or no measurement)" >> "$LOG/driver.log"
+    echo "$(date) FAILED $name (rc=$rc; 124=timeout, 0=no measurement)" \
+      >> "$LOG/driver.log"
     # a killed client can wedge the tunnel; re-probe, then retry once
     until probe; do sleep 120; done
   done
@@ -122,11 +124,15 @@ EOF
       >> "$LOG/driver.log"
     exit 0
   fi
-  if timeout 3000 env $best python bench.py > "$LOG/final.log" 2>&1; then
+  timeout 3000 env $best python bench.py > "$LOG/final.log" 2>&1
+  rc=$?
+  # same measured() gate as the A/B steps: exit-0 on a wedged backend must
+  # not latch final.done on an empty run
+  if [ "$rc" -eq 0 ] && measured "$LOG/final.log"; then
     touch "$LOG/final.done"
     echo "$(date) final full ladder done" >> "$LOG/driver.log"
   else
-    echo "$(date) final full ladder FAILED (rc=$?)" >> "$LOG/driver.log"
+    echo "$(date) final full ladder FAILED (rc=$rc)" >> "$LOG/driver.log"
   fi
 fi
 echo "$(date) A/B ladder complete" >> "$LOG/driver.log"
