@@ -62,6 +62,15 @@ class KMeansParams:
     balanced_max_ratio: float = 2.0  # hard cap = ratio · n/k for balanced lists
 
 
+def _centroid_dtype(x):
+    """Centroids are continuous quantities: float inputs keep their dtype
+    (bf16 stays bf16), integer corpora (uint8/int8 SIFT-class) get f32 —
+    rounding means back to uint8 would wrap residuals and quantize the
+    probe routing (the reference's kmeans also emits float centroids for
+    integer data)."""
+    return x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float32
+
+
 def _assign(x, centroids, tile: int = 4096):
     """(labels, sq_dists) for each row of x against centroids."""
     d, i = _fused_l2_nn(x, centroids, False, min(tile, centroids.shape[0]))
@@ -154,7 +163,7 @@ def _fit_impl(x, key, k: int, max_iter: int, tol: float, init: str, w=None):
              inertia_of(d2), jnp.int32(1))
     c, _, inertia, n_iter = jax.lax.while_loop(cond, body, state)
     labels, d2 = _assign(x, c)
-    return c.astype(x.dtype), labels, inertia_of(d2), n_iter
+    return c.astype(_centroid_dtype(x)), labels, inertia_of(d2), n_iter
 
 
 def kmeans_fit(
@@ -252,7 +261,7 @@ def _fit_sharded(x, key, p: KMeansParams, mesh: Mesh, axis: str):
 
     fit = _sharded_fit_program(mesh, axis, k, p.max_iter, float(p.tol))
     c, inertia, n_iter = fit(x, c0)
-    return c.astype(x.dtype), inertia, n_iter
+    return c.astype(_centroid_dtype(x)), inertia, n_iter
 
 
 def kmeans_predict(x, centroids, *, res=None) -> jax.Array:
@@ -396,7 +405,7 @@ def _balanced_fit_impl(x, key, k: int, max_iter: int, penalty: float, cap: int):
     d2_final = sq_l2(x, c)
     real = jnp.take_along_axis(d2_final, safe[:, None], axis=1)[:, 0]
     inertia = jnp.sum(real * assigned)
-    return c.astype(x.dtype), labels, counts, inertia
+    return c.astype(_centroid_dtype(x)), labels, counts, inertia
 
 
 def _balanced_cap(p: KMeansParams, n: int) -> int:

@@ -449,7 +449,7 @@ def _sharded_build_program(mesh: Mesh, axis: str, per: int, kk: int,
         sub = x_l[jax.random.permutation(key, per)[: min(per, 50 * n_routers)]]
         # kmeans++ for coverage (see _build_routers)
         c, _, _, _ = _fit_impl(sub, key, n_routers, 8, 1e-4, "kmeans++")
-        c = c.astype(x_l.dtype)
+        # router centroids keep the fit dtype (f32 for integer corpora)
         _, nodes = _fused_l2_nn(c, x_l, False, min(4096, per))
         return (x_l[None], graph[None], c[None],
                 nodes.astype(jnp.int32)[None])

@@ -356,7 +356,9 @@ def _sharded_build_program(mesh: Mesh, axis: str, n_orig: int, per: int,
             labels, (x_l, gid), n_lists=n_lists_local, cap=cap,
             fills=(0.0, -1))
         norms = jnp.sum(data.astype(jnp.float32) ** 2, axis=2)
-        return c.astype(x_l.dtype), data, out_ids, counts, norms
+        # centroids keep the fit dtype (f32 for integer corpora —
+        # rounding to uint8 would quantize the probe routing)
+        return c, data, out_ids, counts, norms
 
     return jax.jit(jax.shard_map(
         local, mesh=mesh, in_specs=P(axis),

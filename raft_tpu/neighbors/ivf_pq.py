@@ -621,7 +621,10 @@ def _sharded_coarse_program(mesh, axis: str, per: int, n_lists_local: int,
         c, _, _, _ = _balanced_fit_impl(
             xt, key, n_lists_local, max_iter, penalty, bal_cap)
         lbl = jnp.argmin(sq_l2(xt, c), axis=1)
-        return c.astype(x_l.dtype), xt - c[lbl].astype(xt.dtype)
+        # residual arithmetic in f32: integer subtraction would wrap
+        # (cluster._centroid_dtype rationale); c is already f32 for
+        # integer corpora
+        return c, xt.astype(c.dtype) - c[lbl]
 
     return jax.jit(jax.shard_map(
         local, mesh=mesh, in_specs=P(axis), out_specs=(P(axis), P(axis)),

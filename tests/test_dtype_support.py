@@ -70,6 +70,39 @@ def test_cagra_uint8_build_search(int_data):
     assert float(neighborhood_recall(np.asarray(i), gt)) > 0.9
 
 
+def test_ivf_pq_uint8_build_search(int_data):
+    """IVF-PQ on an integer corpus (reference ships int8/uint8 IVF-PQ):
+    the quantizer chain must run in f32 — uint8 residual arithmetic would
+    wrap (200-250 mod 256) and train garbage codebooks."""
+    from raft_tpu.neighbors import ivf_pq
+
+    db, q, _ = int_data
+    idx = ivf_pq.build(db, ivf_pq.IvfPqIndexParams(n_lists=8, pq_dim=8,
+                                                   seed=0))
+    assert idx.centroids.dtype == jnp.float32
+    for mode in ("recon", "lut"):
+        _, ids = ivf_pq.search(
+            idx, db[:16], 1, ivf_pq.IvfPqSearchParams(n_probes=8, mode=mode))
+        assert (np.asarray(ids)[:, 0] == np.arange(16)).mean() > 0.9, mode
+
+
+def test_kmeans_integer_corpus_f32_centroids(int_data):
+    """Centroid outputs for integer corpora are f32 (continuous
+    quantities); float corpora keep their dtype."""
+    from raft_tpu.cluster.kmeans import (KMeansParams, kmeans_balanced_fit,
+                                         kmeans_fit)
+
+    db, _, _ = int_data
+    c, _, _ = kmeans_fit(db, KMeansParams(n_clusters=8, max_iter=4, seed=0))
+    assert c.dtype == jnp.float32
+    cb, _, _ = kmeans_balanced_fit(db, KMeansParams(n_clusters=8, max_iter=4,
+                                                    seed=0))
+    assert cb.dtype == jnp.float32
+    cf, _, _ = kmeans_fit(db.astype(np.float32) / 255.0,
+                          KMeansParams(n_clusters=8, max_iter=4, seed=0))
+    assert cf.dtype == jnp.float32
+
+
 def test_knn_bfloat16_inputs(int_data):
     db, q, sel = int_data
     dbb = jnp.asarray(db, jnp.bfloat16)
@@ -99,6 +132,24 @@ def test_knn_mixed_dtype_queries(int_data):
     from raft_tpu.stats import neighborhood_recall
 
     assert float(neighborhood_recall(np.asarray(i), np.asarray(i_ref))) >= 0.99
+
+
+def test_sharded_builds_uint8(int_data, mesh8):
+    """Distributed builds on integer corpora: the per-shard quantizer
+    chain must run in f32 end to end (uint8 residual wraparound and
+    uint8-rounded centroids were the single-device bug, duplicated in the
+    shard_map programs)."""
+    from raft_tpu.neighbors import ivf_pq
+
+    db, _, _ = int_data
+    db8 = db[:2960]  # divisible by 8
+    idx = ivf_pq.build_sharded(db8, mesh8,
+                               ivf_pq.IvfPqIndexParams(n_lists=16, pq_dim=8,
+                                                       seed=0))
+    assert idx.centroids.dtype == jnp.float32
+    _, ids = ivf_pq.search_sharded(
+        idx, db8[:16], 1, ivf_pq.IvfPqSearchParams(n_probes=4), mesh=mesh8)
+    assert (np.asarray(ids)[:, 0] == np.arange(16)).mean() > 0.9
 
 
 def test_knn_sharded_uint8(int_data, mesh8):
