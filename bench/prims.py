@@ -302,9 +302,39 @@ def bench_ivf_pq_tiers(quick):
     report("ivf_pq_tiers", f"search_lut4_packed_{n}x{d}", t, nq)
 
 
+def bench_ivf_flat_tiers(quick):
+    """Integer-corpus scoring tier: ivf_flat search on a uint8 corpus takes
+    one exact bf16 MXU pass per probe block vs the f32 corpus's bf16x6
+    HIGHEST passes (`neighbors/_packing.py:exact_gathered_dots`) — measures
+    what the tier buys on real hardware."""
+    from raft_tpu.neighbors import ivf_flat
+
+    n, d = (20_000, 32) if quick else (200_000, 64)
+    nq, k = 256, 10
+    n_lists = 64 if quick else 512
+    key = jax.random.PRNGKey(9)
+    xu8 = jax.block_until_ready(
+        jax.random.randint(key, (n, d), 0, 256, jnp.int32).astype(jnp.uint8))
+    qu8 = jax.block_until_ready(
+        jax.random.randint(jax.random.fold_in(key, 1), (nq, d), 0, 256,
+                           jnp.int32).astype(jnp.uint8))
+    sp = ivf_flat.IvfFlatSearchParams(n_probes=8)
+    idx_u8 = ivf_flat.build(xu8, ivf_flat.IvfFlatIndexParams(n_lists=n_lists,
+                                                             seed=0))
+    t = _time(lambda: ivf_flat.search(idx_u8, qu8, k, sp))
+    report("ivf_flat_tiers", f"search_uint8_{n}x{d}", t, nq)
+    idx_f = ivf_flat.build(xu8.astype(jnp.float32),
+                           ivf_flat.IvfFlatIndexParams(n_lists=n_lists,
+                                                       seed=0))
+    qf = qu8.astype(jnp.float32)
+    t = _time(lambda: ivf_flat.search(idx_f, qf, k, sp))
+    report("ivf_flat_tiers", f"search_f32_{n}x{d}", t, nq)
+
+
 SUITES = {
     "select_k": bench_select_k,
     "ivf_pq_tiers": bench_ivf_pq_tiers,
+    "ivf_flat_tiers": bench_ivf_flat_tiers,
     "reduce": bench_reduce,
     "norm": bench_norm,
     "normalize": bench_normalize,

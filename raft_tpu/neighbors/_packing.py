@@ -140,6 +140,27 @@ def check_filter_covers_ids(keep, ids):
             f"filter covers {keep.shape[-1]} ids, index ids reach {max_id}")
 
 
+def exact_gathered_dots(subscripts: str, vecs, q):
+    """Query·candidate dots for gathered rows — the shared scoring einsum
+    of the IVF-Flat probe scan and the CAGRA beam step.
+
+    8-bit corpora (uint8/int8 data AND queries) take ONE bf16 MXU pass:
+    the values are bf16-exact and the MXU accumulates products in f32, so
+    the result matches the f32 path exactly for d ≤ 256 (sums stay under
+    2²⁴; beyond that the error is sub-ulp of the distance gaps) at ~6× the
+    MXU rate of ``Precision.HIGHEST``.  Float corpora keep the bf16x6
+    HIGHEST passes — for them a single pass would genuinely lose ranking
+    precision."""
+    if vecs.dtype in (jnp.uint8, jnp.int8) and q.dtype in (jnp.uint8,
+                                                           jnp.int8):
+        return jnp.einsum(subscripts, vecs.astype(jnp.bfloat16),
+                          q.astype(jnp.bfloat16),
+                          preferred_element_type=jnp.float32)
+    return jnp.einsum(subscripts, vecs, q,
+                      preferred_element_type=jnp.float32,
+                      precision=jax.lax.Precision.HIGHEST)
+
+
 def keep_lookup(keep, vids):
     """Gather the keep bit for a (possibly −1-padded) id matrix — the one
     id-indexed filter gather every search path shares.  OOB/pad ids are

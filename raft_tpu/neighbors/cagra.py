@@ -318,9 +318,9 @@ def extend(index: CagraIndex, new_vectors,
 def _batch_dists(dataset, q, qn, ids, metric: str):
     """Exact query→candidate distances: [nq, L] for ids [nq, L]."""
     vecs = dataset[jnp.maximum(ids, 0)]  # [nq, L, d]
-    dots = jnp.einsum("qld,qd->ql", vecs, q,
-                      preferred_element_type=jnp.float32,
-                      precision=jax.lax.Precision.HIGHEST)
+    from ._packing import exact_gathered_dots
+
+    dots = exact_gathered_dots("qld,qd->ql", vecs, q)
     if metric == "inner_product":
         return -dots
     vn = jnp.sum(vecs.astype(jnp.float32) ** 2, axis=2)
@@ -353,6 +353,11 @@ def _search_impl(dataset, graph, routers, router_nodes, q, key, k: int,
     deg = graph.shape[1]
     qf = q.astype(jnp.float32)
     qn = jnp.sum(qf * qf, axis=1)
+    # beam scoring takes the RAW query when the 8-bit single-pass tier
+    # applies (_packing.exact_gathered_dots keys on both dtypes); the f32
+    # cast would silently disable it
+    q_score = q if (dataset.dtype in (jnp.uint8, jnp.int8)
+                    and q.dtype in (jnp.uint8, jnp.int8)) else qf
 
     # per-query seeds: nearest router entry nodes (covers every dataset
     # region incl. disconnected components) + shared random extras
@@ -368,7 +373,7 @@ def _search_impl(dataset, graph, routers, router_nodes, q, key, k: int,
         seed_ids = jnp.concatenate(
             [seed_ids, jnp.tile(extra[None, :], (nq, 1))], axis=1
         )
-    seed_vals = _batch_dists(dataset, qf, qn, seed_ids, metric)
+    seed_vals = _batch_dists(dataset, q_score, qn, seed_ids, metric)
     seed_vals, seed_ids = _dedup_by_id(seed_vals, seed_ids)
     beam_val, beam_idx = select_k(seed_vals, itopk, in_idx=seed_ids,
                                   select_min=True)
@@ -385,7 +390,7 @@ def _search_impl(dataset, graph, routers, router_nodes, q, key, k: int,
         # expand adjacency
         nbrs = graph[jnp.maximum(parents, 0)].reshape(nq, width * deg)
         nbrs = jnp.where(jnp.repeat(live, deg, axis=1), nbrs, -1)
-        nvals = _batch_dists(dataset, qf, qn, nbrs, metric)
+        nvals = _batch_dists(dataset, q_score, qn, nbrs, metric)
         nvals = jnp.where(nbrs >= 0, nvals, jnp.inf)
         # merge + dedup
         all_vals = jnp.concatenate([beam_val, nvals], axis=1)

@@ -252,11 +252,9 @@ def _probe_scan(q, qn, data, ids, counts, norms, probes, k: int, metric: str,
         lists = probes[:, p]                      # [nq]
         vecs = data[lists]                        # [nq, cap, d]
         vids = ids[lists]                         # [nq, cap]
-        dots = jnp.einsum(
-            "qcd,qd->qc", vecs, q,
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )
+        from ._packing import exact_gathered_dots
+
+        dots = exact_gathered_dots("qcd,qd->qc", vecs, q)
         if metric == "inner_product":
             dist = -dots
         else:  # sqeuclidean / euclidean rank by squared L2

@@ -134,6 +134,31 @@ def test_knn_mixed_dtype_queries(int_data):
     assert float(neighborhood_recall(np.asarray(i), np.asarray(i_ref))) >= 0.99
 
 
+def test_integer_scoring_tier_matches_f32(int_data):
+    """The single-pass bf16 scoring tier for 8-bit corpora (ivf_flat probe
+    scan, cagra beam) must agree exactly with the f32 pipeline on the same
+    values (uint8 values and their ≤-256-dim dot sums are bf16/f32-exact)."""
+    db, q, _ = int_data
+    idx = ivf_flat.build(db, ivf_flat.IvfFlatIndexParams(n_lists=16, seed=0))
+    sp = ivf_flat.IvfFlatSearchParams(n_probes=16)
+    _, i_u8 = ivf_flat.search(idx, q, 5, sp)
+    idx_f = ivf_flat.build(db.astype(np.float32),
+                           ivf_flat.IvfFlatIndexParams(n_lists=16, seed=0))
+    _, i_f = ivf_flat.search(idx_f, q.astype(np.float32), 5, sp)
+    np.testing.assert_array_equal(np.asarray(i_u8), np.asarray(i_f))
+
+    p = cagra.CagraIndexParams(intermediate_graph_degree=16, graph_degree=8,
+                               build_algo="brute_force", n_routers=32, seed=0)
+    cidx = cagra.build(db, p)
+    csp = cagra.CagraSearchParams(itopk_size=32)
+    _, ci_u8 = cagra.search(cidx, q, 5, csp, seed=0)
+    cidx_f = cagra.CagraIndex(cidx.dataset.astype(jnp.float32), cidx.graph,
+                              cidx.router_centroids.astype(jnp.float32),
+                              cidx.router_nodes, cidx.metric)
+    _, ci_f = cagra.search(cidx_f, q.astype(np.float32), 5, csp, seed=0)
+    np.testing.assert_array_equal(np.asarray(ci_u8), np.asarray(ci_f))
+
+
 def test_sharded_builds_uint8(int_data, mesh8):
     """Distributed builds on integer corpora: the per-shard quantizer
     chain must run in f32 end to end (uint8 residual wraparound and
