@@ -140,19 +140,34 @@ def check_filter_covers_ids(keep, ids):
             f"filter covers {keep.shape[-1]} ids, index ids reach {max_id}")
 
 
+def int8_tier_eligible(a, b, d: int) -> bool:
+    """True when the single-pass bf16 scoring tier is EXACT for a·b dots
+    over contraction length ``d`` — the ONE home of the eligibility rule
+    (every call site must agree or a raw integer query silently reverts a
+    path to the 6× slower HIGHEST einsum).
+
+    Exactness needs every f32 partial sum to stay an exact integer
+    (< 2²⁴): uint8 products reach 255² ⇒ d ≤ 256; int8 reach 128² ⇒
+    d ≤ 1024.  Beyond the bound integer dot gaps of 1 could round away —
+    HIGHEST was exact there, so the tier must not regress it."""
+    kinds = (jnp.uint8, jnp.int8)
+    if a.dtype not in kinds or b.dtype not in kinds:
+        return False
+    lim = 256 if jnp.uint8 in (a.dtype, b.dtype) else 1024
+    return d <= lim
+
+
 def exact_gathered_dots(subscripts: str, vecs, q):
     """Query·candidate dots for gathered rows — the shared scoring einsum
-    of the IVF-Flat probe scan and the CAGRA beam step.
+    of the IVF-Flat probe scan, the CAGRA beam step, and the brute-force
+    exact/refine paths.
 
-    8-bit corpora (uint8/int8 data AND queries) take ONE bf16 MXU pass:
-    the values are bf16-exact and the MXU accumulates products in f32, so
-    the result matches the f32 path exactly for d ≤ 256 (sums stay under
-    2²⁴; beyond that the error is sub-ulp of the distance gaps) at ~6× the
-    MXU rate of ``Precision.HIGHEST``.  Float corpora keep the bf16x6
-    HIGHEST passes — for them a single pass would genuinely lose ranking
-    precision."""
-    if vecs.dtype in (jnp.uint8, jnp.int8) and q.dtype in (jnp.uint8,
-                                                           jnp.int8):
+    Eligible 8-bit corpora (:func:`int8_tier_eligible`) take ONE bf16 MXU
+    pass: the values are bf16-exact and the MXU accumulates products in
+    f32, so the result matches the f32 path exactly at ~6× the MXU rate of
+    ``Precision.HIGHEST``.  Everything else keeps the bf16x6 HIGHEST
+    passes — a single pass would genuinely lose ranking precision there."""
+    if int8_tier_eligible(vecs, q, int(vecs.shape[-1])):
         return jnp.einsum(subscripts, vecs.astype(jnp.bfloat16),
                           q.astype(jnp.bfloat16),
                           preferred_element_type=jnp.float32)

@@ -354,10 +354,11 @@ def _search_impl(dataset, graph, routers, router_nodes, q, key, k: int,
     qf = q.astype(jnp.float32)
     qn = jnp.sum(qf * qf, axis=1)
     # beam scoring takes the RAW query when the 8-bit single-pass tier
-    # applies (_packing.exact_gathered_dots keys on both dtypes); the f32
-    # cast would silently disable it
-    q_score = q if (dataset.dtype in (jnp.uint8, jnp.int8)
-                    and q.dtype in (jnp.uint8, jnp.int8)) else qf
+    # applies (the f32 cast would silently disable it); one shared
+    # eligibility rule keeps this in lockstep with the scorer
+    from ._packing import int8_tier_eligible
+
+    q_score = q if int8_tier_eligible(dataset, q, d) else qf
 
     # per-query seeds: nearest router entry nodes (covers every dataset
     # region incl. disconnected components) + shared random extras

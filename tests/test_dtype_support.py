@@ -159,6 +159,31 @@ def test_integer_scoring_tier_matches_f32(int_data):
     np.testing.assert_array_equal(np.asarray(ci_u8), np.asarray(ci_f))
 
 
+def test_int8_tier_dimension_guard():
+    """Past the exact-accumulation bound (partial sums < 2^24) the tier
+    must fall back to HIGHEST — integer dot gaps of 1 would round away.
+    uint8 caps at d=256, int8 at d=1024; and high-d searches still agree
+    exactly with the f32 pipeline via the fallback."""
+    from raft_tpu.neighbors._packing import int8_tier_eligible
+
+    u8 = np.zeros((2, 2), np.uint8)
+    i8 = np.zeros((2, 2), np.int8)
+    f32 = np.zeros((2, 2), np.float32)
+    assert int8_tier_eligible(u8, u8, 256)
+    assert not int8_tier_eligible(u8, u8, 257)
+    assert int8_tier_eligible(i8, i8, 1024)
+    assert not int8_tier_eligible(i8, i8, 1025)
+    assert not int8_tier_eligible(u8, i8, 512)  # mixed pair uses uint8 cap
+    assert not int8_tier_eligible(u8, f32, 8)
+
+    rng = np.random.default_rng(11)
+    db = rng.integers(0, 256, (400, 300)).astype(np.uint8)  # d > 256
+    _, i_u8 = brute_force.knn(db[:8], db, 5)
+    _, i_f = brute_force.knn(db[:8].astype(np.float32),
+                             db.astype(np.float32), 5)
+    np.testing.assert_array_equal(np.asarray(i_u8), np.asarray(i_f))
+
+
 def test_sharded_builds_uint8(int_data, mesh8):
     """Distributed builds on integer corpora: the per-shard quantizer
     chain must run in f32 end to end (uint8 residual wraparound and
