@@ -221,8 +221,7 @@ def main() -> None:
         p = cagra.CagraIndexParams(
             intermediate_graph_degree=2 * args.graph_degree,
             graph_degree=args.graph_degree, metric=args.metric,
-            build_algo="ivf" if n > 200_000 else "brute_force",
-            n_routers=max(128, min(1024, n // 2000)))
+            build_algo="ivf" if n > 200_000 else "brute_force")  # routers auto
         grid = ([tuple(int(v) for v in pt.split(":")) for pt in args.sweep.split(",")]
                 if args.sweep else [(32, 4), (64, 4), (64, 8)])
         if mesh is not None:

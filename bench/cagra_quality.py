@@ -54,8 +54,7 @@ def main() -> None:
     t0 = time.time()
     p = cagra.CagraIndexParams(
         intermediate_graph_degree=64, graph_degree=32,
-        build_algo="ivf" if rows > 200_000 else "brute_force",
-        n_routers=max(128, min(1024, n_clusters // 2)))
+        build_algo="ivf" if rows > 200_000 else "brute_force")  # routers auto
     idx = cagra.build(db, p)
     build_s = time.time() - t0
     print(f"build: {build_s:.1f}s", file=sys.stderr)

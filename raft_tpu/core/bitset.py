@@ -105,6 +105,41 @@ class Bitset:
         fill = jnp.uint32(0xFFFFFFFF) if default_value else jnp.uint32(0)
         return self._with_words(jnp.full_like(self.words, fill))._mask_tail()
 
+    def resize(self, new_n_bits: int, default_value: bool = True) -> "Bitset":
+        """Grow (new bits take ``default_value``) or truncate — the
+        ``bitset::resize`` role (``core/bitset.hpp:357``)."""
+        expects(new_n_bits >= 0, "new_n_bits must be >= 0")
+        nw_new = _n_words(new_n_bits)
+        nw_old = self.words.shape[0]
+        # branch on BITS, not words: growth within the same tail word
+        # (33→40) still creates new bits that must take the default
+        if new_n_bits <= self.n_bits:
+            out = Bitset(self.words[:nw_new], new_n_bits)
+            return out._mask_tail()
+        fill = jnp.uint32(0xFFFFFFFF) if default_value else jnp.uint32(0)
+        grown = (self.words if nw_new == nw_old else jnp.concatenate(
+            [self.words, jnp.full((nw_new - nw_old,), fill, jnp.uint32)]))
+        if default_value and self.n_bits % _WORD_BITS:
+            # the old tail word's masked-off bits become REAL bits now —
+            # they must take the default, not stay zero
+            tail = self.n_bits // _WORD_BITS
+            high = jnp.uint32(0xFFFFFFFF) << jnp.uint32(
+                self.n_bits % _WORD_BITS)
+            grown = grown.at[tail].set(grown[tail] | high)
+        return Bitset(grown, new_n_bits)._mask_tail()
+
+    def any(self) -> jax.Array:
+        """True if at least one bit is set (``bitset::any`` role)."""
+        return self.count() > 0
+
+    def all(self) -> jax.Array:
+        """True if every bit is set."""
+        return self.count() == self.n_bits
+
+    def none(self) -> jax.Array:
+        """True if no bit is set."""
+        return self.count() == 0
+
     def __and__(self, other: "Bitset") -> "Bitset":
         expects(self.n_bits == other.n_bits, "bitset size mismatch")
         return self._with_words(self.words & other.words)

@@ -50,6 +50,7 @@ __all__ = [
     "build_sharded",
     "extend",
     "optimize_graph",
+    "refine_knn_graph",
     "search",
     "search_sharded",
     "ShardedCagraIndex",
@@ -62,13 +63,23 @@ class CagraIndexParams:
     graph_degree: int = 32
     metric: str = "sqeuclidean"
     build_algo: str = "brute_force"  # brute_force | ivf
-    n_routers: int = 128  # entry-point table size (see _build_routers)
+    # entry-point table size (see _build_routers); 0 = auto ≈ 2·√n.  The
+    # table must out-number the dataset's natural regions or recall caps
+    # at the covered fraction REGARDLESS of search effort (a 300k-row
+    # 300-cluster probe plateaued at 0.49 with 150 routers — beam search
+    # can never enter an uncovered component)
+    n_routers: int = 0
     seed: int = 0
     # accuracy of the intermediate kNN graph when build_algo="ivf": probes
     # per point during graph construction.  The optimize step can only
     # rank-merge edges the intermediate graph found, so this bounds final
     # recall at scale (build time grows ~linearly with it)
     build_n_probes: int = 16
+    # NN-descent rounds over the intermediate graph before edge
+    # optimization (0 = off): each round scores sampled
+    # neighbors-of-neighbors and keeps the best edges by exact distance —
+    # the cheap way to recover recall an approximate (IVF) build left out
+    graph_refine_iters: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -119,28 +130,7 @@ def _optimize_graph_impl(knn_graph, graph_degree: int):
     """
     n, kk = knn_graph.shape
     fwd = knn_graph.astype(jnp.int32)
-    src = jnp.arange(n, dtype=jnp.int32)
-
-    def rev_step(r, carry):
-        rev, rcount = carry
-        dst = fwd[:, r]
-        ok_e = (dst != src) & (dst >= 0) & (dst < n)
-        dst_safe = jnp.where(ok_e, dst, 0)
-        # invalid edges rank in their own spare group so they cannot inflate
-        # the within-group positions of real edges
-        pos = _within_group_rank(jnp.where(ok_e, dst_safe, n), src, n + 1)
-        slot = rcount[dst_safe] + pos
-        ok = ok_e & (slot < kk)
-        dest = jnp.where(ok, dst_safe * kk + slot, n * kk)
-        rev = rev.at[dest].set(src, mode="drop")
-        rcount = rcount + jax.ops.segment_sum(
-            ok.astype(jnp.int32), dst_safe, num_segments=n)
-        return rev, rcount
-
-    rev0 = jnp.full((n * kk,), -1, jnp.int32)
-    rev, _ = jax.lax.fori_loop(
-        0, kk, rev_step, (rev0, jnp.zeros((n,), jnp.int32)))
-    rev = rev.reshape(n, kk)
+    rev = _reverse_graph(fwd)  # phase 1 (shared with NN-descent)
 
     # phase 2: interleave, dedup (keep lowest rank), compact, truncate
     deg = graph_degree
@@ -197,6 +187,119 @@ def optimize_graph(knn_graph, graph_degree: int) -> jax.Array:
     return _optimize_graph_impl(g, int(graph_degree))
 
 
+@partial(jax.jit, static_argnames=())
+def _reverse_graph(graph):
+    """Per-node reverse edges ([n, kk], −1-padded, in arriving-rank order):
+    u appears in row v when v ∈ graph[u].  One pass per forward rank
+    scatters in-edges into each node's next free slots (duplicates within
+    a pass serialized by a within-group rank; invalid edges rank in a
+    spare group so they cannot inflate real positions).  Memory stays
+    O(n·kk).  Shared by the graph optimizer's phase 1 and NN-descent."""
+    n, kk = graph.shape
+    src = jnp.arange(n, dtype=jnp.int32)
+
+    def rev_step(r, carry):
+        rev, rcount = carry
+        dst = graph[:, r]
+        ok_e = (dst != src) & (dst >= 0) & (dst < n)
+        dst_safe = jnp.where(ok_e, dst, 0)
+        pos = _within_group_rank(jnp.where(ok_e, dst_safe, n), src, n + 1)
+        slot = rcount[dst_safe] + pos
+        ok = ok_e & (slot < kk)
+        dest = jnp.where(ok, dst_safe * kk + slot, n * kk)
+        rev = rev.at[dest].set(src, mode="drop")
+        rcount = rcount + jax.ops.segment_sum(
+            ok.astype(jnp.int32), dst_safe, num_segments=n)
+        return rev, rcount
+
+    rev0 = jnp.full((n * kk,), -1, jnp.int32)
+    rev, _ = jax.lax.fori_loop(
+        0, kk, rev_step, (rev0, jnp.zeros((n,), jnp.int32)))
+    return rev.reshape(n, kk)
+
+
+@partial(jax.jit, static_argnames=("s", "block"))
+def _nn_descent_round(x, graph, key, s: int, block: int):
+    """One NN-descent round: every node scores ``s`` sampled candidates
+    from the forward⋈reverse neighbor join against its current ``kk``
+    edges and keeps the best ``kk`` by exact distance (ascending — the
+    rank order ``optimize_graph`` expects).
+
+    The classic kNN-graph improvement loop (NN-descent, Dong et al.;
+    cuVS builds CAGRA graphs with it) recast for the MXU: candidate
+    gathers + one batched einsum per row block, no per-node hash tables.
+    The reverse half of the join is what makes it converge — a degraded
+    edge is usually repaired by a node that LISTS you, not one you list.
+    Row blocks bound peak memory at ``block·(kk+s)·d`` f32."""
+    n, kk = graph.shape
+    rev = _reverse_graph(graph)
+    # unpopulated reverse slots fall back to the forward edge of the same
+    # rank: every sampled (mid, cand) pair stays a real node pair instead
+    # of a wasted −1 draw
+    rev = jnp.where(rev < 0, graph, rev)
+    comb = jnp.concatenate([graph, rev], axis=1)
+    m2 = comb.shape[1]                                       # 2·kk
+    kj, kr = jax.random.split(key)
+    sj = max(1, s - s // 4)
+    cols = jax.random.randint(kj, (n, sj), 0, m2 * m2)
+    mid = jnp.take_along_axis(comb, cols // m2, axis=1)      # [n, sj]
+    cand = comb[jnp.maximum(mid, 0), cols % m2]              # [n, sj]
+    cand = jnp.where(mid < 0, -1, cand)
+    # exploration term: a locally-consistent start (e.g. a 1-probe IVF
+    # graph whose edges never leave their list) is a fixed point of the
+    # pure join; uniform candidates seed cross-partition edges that the
+    # join then propagates through the neighborhood
+    rand = jax.random.randint(kr, (n, s - sj), 0, n, jnp.int32)
+    allc = jnp.concatenate([graph, cand, rand], axis=1)      # [n, kk+s]
+    self_id = jnp.arange(n, dtype=jnp.int32)
+    allc = jnp.where(allc == self_id[:, None], -1, allc)
+
+    pad = (-n) % block
+    allc_p = jnp.pad(allc, ((0, pad), (0, 0)), constant_values=-1)
+    x_p = jnp.pad(x, ((0, pad), (0, 0)))
+
+    def score_block(args):
+        xb, cb = args
+        vecs = x[jnp.maximum(cb, 0)]                         # [b, kk+s, d]
+        from ._packing import exact_gathered_dots
+
+        dots = exact_gathered_dots("bcd,bd->bc", vecs, xb)
+        vn = jnp.sum(vecs.astype(jnp.float32) ** 2, axis=2)
+        xn = jnp.sum(xb.astype(jnp.float32) ** 2, axis=1)
+        dist = jnp.maximum(vn - 2.0 * dots + xn[:, None], 0.0)
+        # dedup by id + drop invalid, then best-kk ascending
+        dist, ids = _dedup_by_id(jnp.where(cb < 0, jnp.inf, dist), cb)
+        neg, pos = jax.lax.top_k(-dist, kk)
+        return jnp.take_along_axis(ids, pos, axis=1)
+
+    out = jax.lax.map(score_block,
+                      (x_p.reshape(-1, block, x.shape[1]),
+                       allc_p.reshape(-1, block, kk + s)))
+    return out.reshape(-1, kk)[:n]
+
+
+def refine_knn_graph(dataset, knn_graph, n_iters: int = 1, *,
+                     sample: int = 0, seed: int = 0,
+                     block: int = 65536) -> jax.Array:
+    """NN-descent refinement of a kNN graph: ``n_iters`` rounds of
+    neighbors-of-neighbors exploration, keeping each node's best edges by
+    exact distance.  Lifts the recall of an approximately-built graph
+    (e.g. the IVF-sourced intermediate graph at scale) without an exact
+    kNN pass.  ``sample`` = candidates scored per node per round
+    (default: 2× the graph degree, a quarter of which is uniform
+    exploration — see ``_nn_descent_round``)."""
+    x = wrap_array(dataset, ndim=2, name="dataset")
+    g = jnp.asarray(knn_graph, jnp.int32)
+    expects(g.ndim == 2 and g.shape[0] == x.shape[0],
+            "knn_graph must be (n, kk) over the dataset rows")
+    s = int(sample) if sample else 2 * int(g.shape[1])
+    key = jax.random.PRNGKey(seed)
+    for i in range(int(n_iters)):
+        g = _nn_descent_round(x, g, jax.random.fold_in(key, i), s,
+                              int(min(block, x.shape[0])))
+    return g
+
+
 def build(dataset, params: Optional[CagraIndexParams] = None, *,
           res=None) -> CagraIndex:
     """Build the optimized graph from scratch."""
@@ -220,9 +323,25 @@ def build(dataset, params: Optional[CagraIndexParams] = None, *,
 
         _, nbrs = brute_force.knn(x, x, kk + 1, metric=p.metric)
     cleaned = _drop_self(jnp.asarray(nbrs), kk)
+    if p.graph_refine_iters:
+        # approximate intermediate graphs (IVF-sourced at scale) leave
+        # recall on the table; NN-descent recovers it for ~one extra
+        # gather+einsum pass per iteration
+        cleaned = refine_knn_graph(x, cleaned, p.graph_refine_iters,
+                                   seed=p.seed)
     graph = optimize_graph(cleaned, p.graph_degree)
-    routers, router_nodes = _build_routers(x, min(p.n_routers, n), p.seed)
+    routers, router_nodes = _build_routers(x, _auto_routers(p.n_routers, n),
+                                           p.seed)
     return CagraIndex(x, graph, routers, router_nodes, p.metric)
+
+
+def _auto_routers(n_routers: int, n: int) -> int:
+    """0 → ≈2·√n (the IVF n_lists heuristic: enough entries to out-number
+    the dataset's natural regions); every result is clamped to n (kmeans
+    cannot make more clusters than rows)."""
+    if n_routers <= 0:
+        return min(n, max(128, int(2 * np.sqrt(n))))
+    return min(n_routers, n)
 
 
 @partial(jax.jit, static_argnames=("kk",))
@@ -259,12 +378,14 @@ def _build_routers(x, n_routers: int, seed: int):
 
 
 def build_from_graph(dataset, knn_graph, graph_degree: int = 32,
-                     metric: str = "sqeuclidean", n_routers: int = 128,
+                     metric: str = "sqeuclidean", n_routers: int = 0,
                      seed: int = 0) -> CagraIndex:
-    """Build from a precomputed kNN graph (cuVS ``build`` overload parity)."""
+    """Build from a precomputed kNN graph (cuVS ``build`` overload parity).
+    ``n_routers=0`` = auto (≈2·√n, see :func:`_auto_routers`)."""
     x = wrap_array(dataset, ndim=2, name="dataset")
     graph = optimize_graph(knn_graph, graph_degree)
-    routers, router_nodes = _build_routers(x, min(n_routers, x.shape[0]), seed)
+    routers, router_nodes = _build_routers(
+        x, _auto_routers(n_routers, x.shape[0]), seed)
     return CagraIndex(x, graph, routers, router_nodes, metric)
 
 
@@ -485,7 +606,7 @@ def build_sharded(dataset, mesh: Mesh,
     x_sh, n, per = shard_rows(dataset, mesh, axis)
     kk = min(p.intermediate_graph_degree, per - 1)
     prog = _sharded_build_program(
-        mesh, axis, per, kk, p.graph_degree, min(p.n_routers, per),
+        mesh, axis, per, kk, p.graph_degree, _auto_routers(p.n_routers, per),
         p.metric, p.seed, min(8192, per))
     ds, graphs, rc, rn = prog(x_sh)
     return ShardedCagraIndex(ds, graphs, rc, rn, p.metric, n)

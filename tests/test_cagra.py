@@ -1,6 +1,7 @@
 """CAGRA graph index tests: graph structure invariants + search recall vs
 brute force."""
 
+import dataclasses
 import os
 
 import jax
@@ -80,6 +81,76 @@ def test_cagra_ivf_build_n_probes(blob_data):
         recalls.append(_recall(got, want))
     assert recalls[1] >= recalls[0] - 0.02  # never meaningfully worse
     assert recalls[1] > 0.9
+
+
+def test_nn_descent_improves_degraded_graph(blob_data):
+    """NN-descent must recover kNN-graph recall that a cheap approximate
+    build left out (the quality lever for IVF-sourced graphs at scale)."""
+    x, _ = blob_data
+    kk = 16
+    _, exact = brute_force.knn(x, x, kk + 1)
+    exact = cagra._drop_self(jnp.asarray(exact), kk)
+
+    # degraded starting graph: exact edges with half the columns replaced
+    # by random ids (simulating a low-probe IVF build)
+    rng = np.random.default_rng(0)
+    g0 = np.asarray(exact).copy()
+    g0[:, kk // 2:] = rng.integers(0, x.shape[0], g0[:, kk // 2:].shape)
+
+    def graph_recall(g):
+        hit = (np.asarray(g)[:, :, None] == np.asarray(exact)[:, None, :])
+        return hit.any(axis=1).mean()
+
+    r0 = graph_recall(g0)
+    g1 = cagra.refine_knn_graph(x, g0, n_iters=2, seed=0)
+    r1 = graph_recall(g1)
+    assert r1 > r0 + 0.1, (r0, r1)
+    # refined rows are valid ids sorted by ascending exact distance
+    g1 = np.asarray(g1)
+    assert (g1 >= 0).all() and (g1 < x.shape[0]).all()
+    d0 = np.linalg.norm(x[g1[5]] - x[5][None, :], axis=1)
+    assert (np.diff(d0) >= -1e-4).all()
+
+
+def test_cagra_build_with_refine_iters(blob_data):
+    """build(graph_refine_iters=2) plumbs the NN-descent pass: the refined
+    build produces a different (never worse-searching) graph."""
+    x, q = blob_data
+    _, want = brute_force.knn(q, x, 10)
+    sp = cagra.CagraSearchParams(itopk_size=64, search_width=4)
+    base = cagra.CagraIndexParams(intermediate_graph_degree=24,
+                                  graph_degree=16, build_algo="ivf",
+                                  build_n_probes=1)
+    idx0 = cagra.build(x, base)
+    refined = dataclasses.replace(base, graph_refine_iters=2)
+    idx1 = cagra.build(x, refined)
+    assert (np.asarray(idx0.graph) != np.asarray(idx1.graph)).any()
+    _, got0 = cagra.search(idx0, q, 10, sp)
+    _, got1 = cagra.search(idx1, q, 10, sp)
+    assert _recall(got1, want) >= _recall(got0, want) - 0.01
+    assert _recall(got1, want) > 0.85
+
+
+def test_cagra_router_coverage_auto(blob_data):
+    """Auto router sizing must cover every natural region: recall with the
+    auto table beats a deliberately-undersized one on many-cluster data
+    (the 300k-probe failure mode, shrunk to CPU scale)."""
+    from raft_tpu.random.datagen import make_blobs as mb
+
+    x, _ = mb(jax.random.PRNGKey(5), n_samples=8000, n_features=24,
+              n_clusters=200, cluster_std=0.5)
+    x = np.asarray(x)
+    q = x[:200]
+    _, want = brute_force.knn(q, x, 5)
+    sp = cagra.CagraSearchParams(itopk_size=64, search_width=4)
+    base = cagra.CagraIndexParams(intermediate_graph_degree=24,
+                                  graph_degree=16)
+    small = dataclasses.replace(base, n_routers=64)  # < 200 clusters
+    _, got_small = cagra.search(cagra.build(x, small), q, 5, sp)
+    _, got_auto = cagra.search(cagra.build(x, base), q, 5, sp)
+    r_small, r_auto = _recall(got_small, want), _recall(got_auto, want)
+    assert r_auto > r_small + 0.1, (r_small, r_auto)
+    assert r_auto > 0.9, r_auto
 
 
 def test_cagra_build_from_graph(blob_data):

@@ -96,6 +96,28 @@ class TestBitset:
         assert int((a & b).count()) == 1
         assert int((a | b).count()) == 3
 
+    def test_resize(self):
+        """bitset::resize parity (core/bitset.hpp:357): grown bits take the
+        default — including the old tail word's previously-masked bits —
+        and truncation re-masks the new tail."""
+        bs = Bitset.create(33, default_value=False).set(np.array([0, 32]))
+        grown = bs.resize(70, default_value=True)
+        assert grown.n_bits == 70
+        assert int(grown.count()) == 2 + (70 - 33)  # old bits kept
+        assert bool(grown.test(32)) and not bool(grown.test(5))
+        assert bool(grown.test(33)) and bool(grown.test(69))
+        shrunk = grown.resize(33, default_value=True)
+        assert shrunk.n_bits == 33 and int(shrunk.count()) == 2
+        grown0 = bs.resize(70, default_value=False)
+        assert int(grown0.count()) == 2
+
+    def test_any_all_none(self):
+        bs = Bitset.create(10, default_value=False)
+        assert bool(bs.none()) and not bool(bs.any()) and not bool(bs.all())
+        bs = bs.set(np.array([3]))
+        assert bool(bs.any()) and not bool(bs.all()) and not bool(bs.none())
+        assert bool(bs.reset(True).all())
+
     def test_bitmap(self):
         bm = Bitmap.create_2d(4, 40, default_value=False)
         bm = bm.set2(2, 5)
