@@ -161,8 +161,16 @@ def _bench_ivf_pq(rows=None):
         # trainset ≈ 160k rows so the balanced fit's (n_train, n_lists)
         # distance matrix stays ~2.6 GB at L=4096 (fits HBM with the slabs)
         kmeans_trainset_fraction=min(0.1, 160_000 / max(n, 1)))
-    index = ivf_pq.build_chunked(db_host, p, chunk_rows=131072)
+    # peak device memory ATTRIBUTABLE to the build (VERDICT r3 next #5:
+    # report HBM alongside wall time) — scoped tracker, not the process-
+    # lifetime high-water mark the GT computation above already raised
+    from raft_tpu.core.memory import MemoryTracker
+
+    with MemoryTracker() as mt:
+        index = ivf_pq.build_chunked(db_host, p, chunk_rows=131072)
     build_s = time.time() - t0
+    peak_mb = (round(mt.peak_bytes / 1e6, 1)
+               if mt.peak_bytes is not None else None)
 
     curve = sweep_ivf_pq(index, q, gt, K, [4, 8, 16, 32],
                          refine_dataset=db_dev, refine_ratio=4)
@@ -172,7 +180,8 @@ def _bench_ivf_pq(rows=None):
                               refine_dataset=db_dev, refine_ratio=4)
     best = best_at_recall(curve, RECALL_FLOOR)
     return {"rows": n, "dim": d, "n_lists": n_lists, "pq_dim": d // 2,
-            "build_s": round(build_s, 1), "curve": curve,
+            "build_s": round(build_s, 1), "peak_device_mb": peak_mb,
+            "curve": curve,
             "qps_at_recall95": None if best is None else best["qps"],
             "best": best}
 
