@@ -112,6 +112,17 @@ def test_nn_descent_improves_degraded_graph(blob_data):
     assert (np.diff(d0) >= -1e-4).all()
 
 
+def test_nn_descent_block_invariant(blob_data):
+    """Row-block chunking is a memory knob, not a semantic one: results
+    must be identical for any block size (incl. non-dividing)."""
+    x, _ = blob_data
+    _, nbrs = brute_force.knn(x, x, 9)
+    g0 = cagra._drop_self(jnp.asarray(nbrs), 8)
+    a = cagra.refine_knn_graph(x, g0, n_iters=1, seed=3, block=x.shape[0])
+    b = cagra.refine_knn_graph(x, g0, n_iters=1, seed=3, block=700)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_cagra_build_with_refine_iters(blob_data):
     """build(graph_refine_iters=2) plumbs the NN-descent pass: the refined
     build produces a different (never worse-searching) graph."""
