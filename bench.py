@@ -50,6 +50,12 @@ import traceback
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench"))
 
+# persistent XLA executable cache, inherited by the probe and every config
+# subprocess: A/B reruns of the same config pay each compile once per
+# machine, not once per process (the parent itself never imports jax)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.expanduser("~/.cache/raft_tpu_jax"))
+
 N_DB = int(os.environ.get("RAFT_BENCH_BF_ROWS", 1_000_000))
 N_QUERY = min(10_000, max(100, N_DB // 100))
 DIM = 128

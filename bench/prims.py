@@ -24,6 +24,11 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
+# persistent XLA executable cache (shared with bench.py): repeat runs
+# on the same machine skip recompilation
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.expanduser("~/.cache/raft_tpu_jax"))
+
 import jax
 import jax.numpy as jnp
 import numpy as np
