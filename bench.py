@@ -373,10 +373,9 @@ def _child_main(short: str) -> None:
     """
     if os.environ.get("RAFT_BENCH_FAKE_SLOW_CONFIG"):  # test hook: hung op
         time.sleep(3600)
-    if os.environ.get("RAFT_BENCH_PLATFORM"):  # e.g. =cpu for smoke runs
-        import jax
+    from _platform import pin_backend  # RAFT_BENCH_PLATFORM=cpu for smoke runs
 
-        jax.config.update("jax_platforms", os.environ["RAFT_BENCH_PLATFORM"])
+    pin_backend()
 
     _, name, fn, full_rows, floor, _ = _config_row(short)
     if short == "brute_force":

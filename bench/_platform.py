@@ -1,0 +1,28 @@
+"""Backend pinning shared by every bench entry point.
+
+The rule lives exactly once here: ``RAFT_BENCH_PLATFORM`` (e.g. ``cpu``
+for smoke runs and scaling probes) must be applied with a programmatic
+``jax.config.update`` BEFORE backend initialization — a ``JAX_PLATFORMS``
+env var alone is not enough because the axon PJRT plugin's sitecustomize
+``register()`` overrides it.  (``bench.py``'s subprocess probe carries an
+inlined copy in ``_PROBE_SRC``: it must stay self-contained source text.)
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def pin_backend(argv=None) -> None:
+    """Apply ``RAFT_BENCH_PLATFORM`` (or a ``--cpu`` alias in ``argv``).
+
+    Call immediately after ``import jax`` and before anything touches a
+    backend.  ``--cpu`` in ``argv`` wins over the env var.
+    """
+    platform = os.environ.get("RAFT_BENCH_PLATFORM")
+    if argv and "--cpu" in argv:
+        platform = "cpu"
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)

@@ -38,10 +38,9 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                       os.path.expanduser("~/.cache/raft_tpu_jax"))
 
-if os.environ.get("RAFT_BENCH_PLATFORM"):  # e.g. =cpu for smoke tests
-    import jax
+from _platform import pin_backend  # e.g. RAFT_BENCH_PLATFORM=cpu for smoke tests
 
-    jax.config.update("jax_platforms", os.environ["RAFT_BENCH_PLATFORM"])
+pin_backend()
 
 import numpy as np
 

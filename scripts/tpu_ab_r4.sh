@@ -9,38 +9,26 @@
 # recall-gated (recall >= 0.999 or the fast path is rejected in-config)
 # and ratchet BENCH_HISTORY.json only on genuine full-scale TPU wins.
 set -u
-cd /root/repo
+cd /root/repo || exit 1
 LOG=/tmp/tpu_ab_r4
 mkdir -p "$LOG"
 R3LOG=/tmp/tpu_jobs_r3/driver.log
+. "$(dirname "$0")/tpu_queue_lib.sh"
 
 echo "$(date) waiting for the r3 queue to finish..." >> "$LOG/driver.log"
 until [ -f "$R3LOG" ] && grep -q "all steps attempted" "$R3LOG"; do
   sleep 120
 done
+# take the shared tunnel lock (blocking: the queue process may still be
+# exiting between its marker write and lock release)
+# blocking: the marker line can be a stale one from an earlier completed
+# round while a re-run queue is still mid-ladder — wait it out, however long
+exec 9> /tmp/tpu_jobs_r3/queue.lock
+flock 9
 echo "$(date) r3 queue done; starting A/B" >> "$LOG/driver.log"
 
-probe() { timeout 120 python -c "import jax, jax.numpy as jnp; (jnp.ones((8,8)) @ jnp.ones((8,8))).sum().item()" >/dev/null 2>&1; }
-
-# bench.py exits 0 even on a wedged backend (by design: the driver must
-# always get a final line) — .done therefore requires an actual headline
-# MEASUREMENT in the log, not just exit-0
-measured() {
-  python - "$1" <<'EOF'
-import json, sys
-ok = False
-for ln in open(sys.argv[1]):
-    if not ln.startswith("{"):
-        continue
-    try:
-        d = json.loads(ln)
-    except ValueError:
-        continue
-    if d.get("config", "").startswith("brute_force") and d.get("qps", 0) > 0:
-        ok = True
-sys.exit(0 if ok else 1)
-EOF
-}
+# .done requires an actual headline MEASUREMENT (see tpu_queue_lib.sh)
+measured() { bench_measured "$1" brute_force; }
 
 run_step() {
   local name=$1; shift
@@ -48,7 +36,7 @@ run_step() {
   local attempt
   for attempt in 1 2; do
     echo "$(date) start $name (attempt $attempt): $*" >> "$LOG/driver.log"
-    timeout 1500 env "$@" python bench.py > "$LOG/$name.log" 2>&1
+    timeout 1500 env "$@" python bench.py > "$LOG/$name.log" 2>&1 9<&-
     rc=$?
     if [ "$rc" -eq 0 ] && measured "$LOG/$name.log"; then
       touch "$LOG/$name.done"
@@ -124,7 +112,7 @@ EOF
       >> "$LOG/driver.log"
     exit 0
   fi
-  timeout 3000 env $best python bench.py > "$LOG/final.log" 2>&1
+  timeout 3000 env $best python bench.py > "$LOG/final.log" 2>&1 9<&-
   rc=$?
   # same measured() gate as the A/B steps: exit-0 on a wedged backend must
   # not latch final.done on an empty run

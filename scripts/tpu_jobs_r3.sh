@@ -2,14 +2,19 @@
 # Round-3 TPU job queue: waits for the axon tunnel to come back, then runs
 # the benchmark/validation sequence in priority order, logging to /tmp.
 # Safe to re-run; each step is skipped if its marker file exists.
+# DEPRECATED in favor of scripts/tpu_jobs_r4.sh (risk-reordered ladder,
+# measurement-gated markers).  Kept runnable; shares the queue lock so the
+# two can never drive the tunnel concurrently.
 set -u
-cd /root/repo
+cd /root/repo || exit 1
 LOG=/tmp/tpu_jobs_r3
 mkdir -p "$LOG"
+. "$(dirname "$0")/tpu_queue_lib.sh"
+acquire_queue_lock tpu_jobs_r3
 
 # a real computation, not just jax.devices(): backend init can succeed
 # while the compute leg of the tunnel is wedged
-probe() { timeout 120 python -c "import jax, jax.numpy as jnp; (jnp.ones((8,8)) @ jnp.ones((8,8))).sum().item()" >/dev/null 2>&1; }
+# probe() comes from tpu_queue_lib.sh (600s timeout, stderr capture, 9<&-)
 
 echo "$(date) waiting for TPU..." >> "$LOG/driver.log"
 # Long sleeps between probes: each failed probe kills a client mid-init,
@@ -27,7 +32,7 @@ run_step() {  # name, command...  (bounded: a hung tunnel must not block
   local name=$1; shift            #  the rest of the queue)
   [ -f "$LOG/$name.done" ] && return 0
   echo "$(date) start $name" >> "$LOG/driver.log"
-  if timeout 3000 "$@" > "$LOG/$name.log" 2>&1; then
+  if timeout 3000 "$@" > "$LOG/$name.log" 2>&1 9<&-; then
     touch "$LOG/$name.done"
     echo "$(date) done $name" >> "$LOG/driver.log"
   else

@@ -11,49 +11,14 @@
 #   6. profile        — stage-by-stage flagship profile (diagnostic)
 # Markers live in the SAME dir as the r3 queue (/tmp/tpu_jobs_r3) so
 # tpu_ab_r4.sh's wait-for-"all steps attempted" chain keeps working and
-# any step the r3 queue already completed is not repeated.  Only ONE of
-# tpu_jobs_r3.sh / tpu_jobs_r4.sh may run at a time (single-client tunnel).
+# any step the r3 queue already completed is not repeated.  The shared
+# queue.lock (tpu_queue_lib.sh) enforces one queue per tunnel.
 set -u
 cd /root/repo || exit 1
 LOG=/tmp/tpu_jobs_r3
 mkdir -p "$LOG"
-
-# single-queue lock: r3/r4 queue scripts share the marker dir and the
-# single-client tunnel, so exactly one may run
-exec 9> "$LOG/queue.lock"
-if ! flock -n 9; then
-  echo "$(date) another queue instance holds $LOG/queue.lock; exiting" >&2
-  exit 1
-fi
-
-probe() { timeout 120 python -c "import jax, jax.numpy as jnp; (jnp.ones((8,8)) @ jnp.ones((8,8))).sum().item()" >/dev/null 2>&1; }
-
-wait_probe() {
-  until probe; do
-    echo "$(date) probe failed; quiet for ${SLEEP_S}s" >> "$LOG/driver.log"
-    sleep "$SLEEP_S"
-  done
-}
-
-# bench.py exits 0 even on a wedged backend (by design: the round driver
-# must always get a final line), so exit status alone must never latch
-# bench.done — require an actual qps measurement in the log.
-bench_measured() {
-  python - "$1" <<'EOF'
-import json, sys
-ok = False
-for ln in open(sys.argv[1]):
-    if not ln.startswith("{"):
-        continue
-    try:
-        d = json.loads(ln)
-    except ValueError:
-        continue
-    if d.get("qps", 0) > 0 or d.get("tflops", 0) > 0:
-        ok = True
-sys.exit(0 if ok else 1)
-EOF
-}
+. "$(dirname "$0")/tpu_queue_lib.sh"
+acquire_queue_lock tpu_jobs_r4
 
 # a bench.done latched by the r3 queue's status-only gate (or an earlier
 # r4 run against a wedged backend) must not skip the top-priority step
@@ -63,9 +28,6 @@ if [ -f "$LOG/bench.done" ] && ! bench_measured "$LOG/bench.log" 2>/dev/null; th
 fi
 
 echo "$(date) [r4 queue] waiting for TPU..." >> "$LOG/driver.log"
-# Long quiet windows: a probe killed mid-init is itself what wedges the
-# tunnel, so losing chip minutes to a sleep beats extending the wedge.
-SLEEP_S=${TPU_PROBE_SLEEP:-1200}
 wait_probe
 echo "$(date) TPU is back" >> "$LOG/driver.log"
 
@@ -75,7 +37,7 @@ run_step() {  # name, timeout_s, command...   (two attempts, gated .done)
   local attempt
   for attempt in 1 2; do
     echo "$(date) start $name (attempt $attempt)" >> "$LOG/driver.log"
-    timeout "$tmo" "$@" > "$LOG/$name.$attempt.log" 2>&1
+    timeout "$tmo" "$@" > "$LOG/$name.$attempt.log" 2>&1 9<&-
     rc=$?
     cp -f "$LOG/$name.$attempt.log" "$LOG/$name.log"  # latest = canonical
     if [ "$rc" -eq 0 ]; then

@@ -1,0 +1,60 @@
+# Shared helpers for the TPU job-queue scripts (tpu_jobs_r3.sh,
+# tpu_jobs_r4.sh, tpu_ab_r4.sh).  Source after setting LOG:
+#   LOG=/tmp/tpu_jobs_r3; . "$(dirname "$0")/tpu_queue_lib.sh"
+# Single-client tunnel discipline lives here so every queue enforces the
+# same rules and fixes land exactly once.
+
+# Generous timeout: the tunnel can take minutes to grant a new client
+# after the previous one exits, and killing a would-have-succeeded client
+# mid-init is the very action that wedges the grant.  stderr accumulates
+# (append) so wedge-era diagnostics survive the recovering probe.
+# 9<&- : children must not inherit the queue-lock fd — an orphaned child
+# of a killed queue would otherwise hold the flock until it exits.
+probe() {
+  timeout "${TPU_PROBE_TIMEOUT:-600}" python -c "import jax, jax.numpy as jnp; (jnp.ones((8,8)) @ jnp.ones((8,8))).sum().item()" \
+    > /dev/null 2>> "$LOG/probe_stderr.log" 9<&-
+}
+
+# Long quiet windows between failed probes: losing chip minutes to a
+# sleep beats extending a wedge with another killed client.
+wait_probe() {
+  local sleep_s="${TPU_PROBE_SLEEP:-1200}"
+  until probe; do
+    echo "$(date) probe failed; quiet for ${sleep_s}s" >> "$LOG/driver.log"
+    sleep "$sleep_s" 9<&-
+  done
+}
+
+# All queue scripts share one flock: exactly one may drive the tunnel.
+# Call with the script's own name for the log line.
+acquire_queue_lock() {
+  exec 9> "$LOG/queue.lock"
+  if ! flock -n 9; then
+    echo "$(date) $1: another queue holds $LOG/queue.lock; exiting" >&2
+    exit 1
+  fi
+}
+
+# bench.py exits 0 even on a wedged backend (by design: the round driver
+# must always get a final line), so exit status alone must never latch a
+# .done marker — require an actual measurement in the log.  Optional 2nd
+# arg restricts the check to configs whose name starts with that prefix.
+bench_measured() {
+  python - "$1" "${2:-}" <<'EOF'
+import json, sys
+path, prefix = sys.argv[1], sys.argv[2]
+ok = False
+for ln in open(path):
+    if not ln.startswith("{"):
+        continue
+    try:
+        d = json.loads(ln)
+    except ValueError:
+        continue
+    if prefix and not d.get("config", "").startswith(prefix):
+        continue
+    if d.get("qps", 0) > 0 or d.get("tflops", 0) > 0:
+        ok = True
+sys.exit(0 if ok else 1)
+EOF
+}
