@@ -1,0 +1,161 @@
+"""Mosaic-compile validation of the Pallas kernels at production block
+shapes (VERDICT r4 next #4).
+
+The test suite pins CPU and runs every Pallas kernel in interpret mode
+(``select_k.py:128``, ``fused_l2_topk.py:161``), so CI can be green while
+a kernel fails to *compile* on hardware — and the select_k tuner has
+observed real Mosaic failures (k=32, cols >= 16384, pre-fori_loop).  This
+script is the hardware gate: it runs each kernel NON-interpreted on
+whatever backend is present and asserts agreement with interpret mode
+(exact for the integer paths, allclose for bf16 where accumulation order
+may differ).  Reference analog: the ext_headers discipline of compiling
+the same sources in every consumption mode
+(``/root/reference/cpp/tests/CMakeLists.txt:128-139``).
+
+Cheap by design (~1 min + compiles) so any healthy tunnel minute can run
+it — wired FIRST in ``scripts/tpu_jobs_r5.sh``.  Writes a backend-stamped
+artifact to ``bench/MOSAIC_CHECK.json`` and exits nonzero on any failure.
+"""
+
+import datetime
+import json
+import os
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+sys.path.insert(0, os.path.join(_ROOT, "bench"))
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.expanduser("~/.cache/raft_tpu_jax"))
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                   "bench", "MOSAIC_CHECK.json")
+
+
+def main() -> None:
+    import jax
+
+    # RAFT_BENCH_PLATFORM smoke-runs the *script logic* on CPU (kernels
+    # fall back to interpret — compile coverage needs a real TPU)
+    from _platform import pin_backend
+
+    pin_backend(sys.argv)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    if os.environ.get("RAFT_MOSAIC_REQUIRE_TPU") and not on_tpu:
+        # queue gate: a CPU fallback passing in interpret mode must not
+        # latch the step's .done marker as Mosaic coverage
+        print(json.dumps({"mosaic_check": "refused",
+                          "backend": backend,
+                          "error": "RAFT_MOSAIC_REQUIRE_TPU set but backend "
+                                   "is not tpu"}), flush=True)
+        sys.exit(1)
+    checks = {}
+
+    def run(name, fn):
+        t0 = time.time()
+        try:
+            fn()
+            checks[name] = {"ok": True, "s": round(time.time() - t0, 1)}
+        except Exception as e:  # noqa: BLE001 — record, keep probing others
+            checks[name] = {"ok": False, "s": round(time.time() - t0, 1),
+                            "error": f"{type(e).__name__}: {e}"}
+        print(json.dumps({"check": name, **checks[name]}), flush=True)
+
+    rng = np.random.default_rng(7)
+
+    # --- select_k: production fast-path bucket (brute-force refine stage
+    # shape class: cols 2048, k 64, default blocks bm=256/bn=2048) --------
+    def check_select_k(batch, length, k):
+        from raft_tpu.ops.pallas.select_k import _call, select_k_pallas
+
+        x = jnp.asarray(rng.normal(size=(batch, length)).astype(np.float32))
+        v, i = select_k_pallas(x, k)          # non-interpreted on TPU
+        v, i = np.asarray(v), np.asarray(i)
+        xs = np.sort(np.asarray(x), axis=1)[:, :k]
+        np.testing.assert_allclose(v, xs, rtol=0, atol=0)
+        if on_tpu:  # Mosaic vs interpret on identical inputs: exact
+            bn = min(2048, length)
+            vi, ii = _call(x, k, min(256, batch), bn, True)
+            np.testing.assert_array_equal(v, np.asarray(vi))
+            np.testing.assert_array_equal(i, np.asarray(ii))
+
+    run("select_k_prod_2048_k64", lambda: check_select_k(1024, 2048, 64))
+    # the shape class the tuner saw Mosaic REJECT pre-fori_loop
+    run("select_k_wide_16384_k32", lambda: check_select_k(256, 16384, 32))
+
+    # --- fused_shortlist bf16 + int8 at production blocks (bm 256/1024,
+    # bn 2048 — the bench fast path's defaults) ---------------------------
+    def check_shortlist(dtype):
+        from raft_tpu.ops.pallas.fused_l2_topk import (fused_shortlist,
+                                                       int8_surrogate_norms)
+
+        m, n, d, k = 256, 8192, 128, 10
+        if dtype == np.float32:
+            x = rng.normal(size=(m, d)).astype(dtype)
+            y = rng.normal(size=(n, d)).astype(dtype)
+            yn = jnp.asarray((y * y).sum(axis=1).astype(np.float32))
+            d2 = ((x[:, None, :] - y[None, :, :]) ** 2).sum(-1)
+        else:
+            x = rng.integers(0, 256, (m, d)).astype(dtype)
+            y = rng.integers(0, 256, (n, d)).astype(dtype)
+            yn = int8_surrogate_norms(jnp.asarray(y))
+            d2 = ((x.astype(np.int64)[:, None, :]
+                   - y.astype(np.int64)[None, :, :]) ** 2).sum(-1)
+        sv, si = fused_shortlist(jnp.asarray(x), jnp.asarray(y), yn, bn=2048)
+        si = np.asarray(si)
+        true = np.argsort(d2, axis=1)[:, :k]
+        rec = np.mean([len(set(t) & set(s)) for t, s in zip(true, si)]) / k
+        assert rec > 0.99, f"shortlist recall {rec}"
+        if on_tpu:  # Mosaic vs interpret (int8: exact int32 accumulation)
+            from raft_tpu.ops.pallas.fused_l2_topk import _call, center_int8
+
+            xb, yb = jnp.asarray(x), jnp.asarray(y)
+            if dtype == np.uint8:
+                xb, yb = center_int8(xb), center_int8(yb)
+            else:
+                xb, yb = xb.astype(jnp.bfloat16), yb.astype(jnp.bfloat16)
+            ref = _call(xb, yb, yn.reshape(1, -1).astype(jnp.float32),
+                        256, 2048, True)
+            tol = 0 if dtype == np.uint8 else 1e-3
+            np.testing.assert_allclose(np.asarray(sv), np.asarray(ref[0]),
+                                       rtol=tol, atol=tol)
+
+    run("fused_shortlist_bf16", lambda: check_shortlist(np.float32))
+    run("fused_shortlist_int8", lambda: check_shortlist(np.uint8))
+
+    # --- bin_select (XLA two-pass path, no Pallas — still worth a TPU
+    # compile pass since kAuto can dispatch production rows onto it) ------
+    def check_bin_select():
+        from raft_tpu.ops.bin_select import bin_select_k
+
+        x = rng.normal(size=(512, 16384)).astype(np.float32)
+        v, i = bin_select_k(jnp.asarray(x), 64)
+        np.testing.assert_allclose(np.sort(np.asarray(v), axis=1),
+                                   np.sort(x, axis=1)[:, :64], rtol=1e-6)
+
+    run("bin_select_16384_k64", lambda: check_bin_select())
+
+    ok = all(c["ok"] for c in checks.values())
+    art = {"backend": backend, "mosaic": on_tpu,
+           "date": datetime.date.today().isoformat(),
+           "ok": ok, "checks": checks}
+    # only a real-hardware pass may overwrite a previous real-hardware stamp
+    if on_tpu or not os.path.exists(OUT):
+        with open(OUT, "w") as f:
+            json.dump(art, f, indent=1)
+            f.write("\n")
+    print(json.dumps({"mosaic_check": "done", **{k: v for k, v in art.items()
+                                                 if k != "checks"}}),
+          flush=True)
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -19,10 +19,9 @@ echo "$(date) waiting for the r3 queue to finish..." >> "$LOG/driver.log"
 until [ -f "$R3LOG" ] && grep -q "all steps attempted" "$R3LOG"; do
   sleep 120
 done
-# take the shared tunnel lock (blocking: the queue process may still be
-# exiting between its marker write and lock release)
-# blocking: the marker line can be a stale one from an earlier completed
-# round while a re-run queue is still mid-ladder — wait it out, however long
+# take the shared tunnel lock, blocking: the marker line can be a stale one
+# from an earlier completed round while a re-run queue is still mid-ladder —
+# wait it out, however long
 exec 9> /tmp/tpu_jobs_r3/queue.lock
 flock 9
 echo "$(date) r3 queue done; starting A/B" >> "$LOG/driver.log"
@@ -45,8 +44,10 @@ run_step() {
     fi
     echo "$(date) FAILED $name (rc=$rc; 124=timeout, 0=no measurement)" \
       >> "$LOG/driver.log"
-    # a killed client can wedge the tunnel; re-probe, then retry once
-    until probe; do sleep 120; done
+    # a killed client can wedge the tunnel; re-probe with the lib's quiet-
+    # window cadence (aggressive 120 s polling is the documented wedge
+    # trigger), then retry once
+    wait_probe
   done
 }
 

@@ -70,6 +70,41 @@ def test_hung_config_watchdog_keeps_ladder_alive():
     assert "error" not in d  # backend stayed healthy; ladder ran to the end
 
 
+def test_ckpt_rerun_replays_completed_configs(tmp_path):
+    """VERDICT r4 weak #5 drill: wedge config 3 of the ladder mid-run; the
+    rerun must serve configs 1–2 from the run-scoped checkpoint instead of
+    re-measuring (or worse, losing) them."""
+    env = _env(RAFT_BENCH_CKPT_DIR=str(tmp_path),
+               RAFT_BENCH_BF_ROWS=2000,           # CPU-feasible scales
+               RAFT_BENCH_SKIP="cagra,ivf_flat",
+               RAFT_BENCH_FAKE_SLOW_CONFIG="ivf_pq",  # wedge config 3 only
+               RAFT_BENCH_CONFIG_TIMEOUT_S="ivf_pq=5")
+    p1 = subprocess.run([sys.executable, BENCH], capture_output=True,
+                        text=True, timeout=600, env=env)
+    assert p1.returncode == 0, p1.stderr
+    d1 = _final_line(p1.stdout)
+    assert d1["value"] > 0, d1                      # config 1 measured
+    assert d1["north_star"]["pairwise_10kx128"]["tflops"] > 0  # config 2
+    # the wedged config hit its watchdog and must NOT have checkpointed
+    assert d1["north_star"]["ivf_pq_deep10m_class"]["skipped"] \
+        == "watchdog_timeout"
+    assert sorted(f.name for f in tmp_path.iterdir()) \
+        == ["brute_force.json", "pairwise.json"]
+
+    # rerun: configs 1–2 replay from checkpoint (fast — the watchdogged
+    # config is the only one that spends wall time), config 3 retried
+    env["RAFT_BENCH_CONFIG_TIMEOUT_S"] = "ivf_pq=3"
+    p2 = subprocess.run([sys.executable, BENCH], capture_output=True,
+                        text=True, timeout=300, env=env)
+    assert p2.returncode == 0, p2.stderr
+    d2 = _final_line(p2.stdout)
+    assert d2["value"] == d1["value"]               # config 1 survived
+    assert d2["profile"].get("from_checkpoint") is True
+    assert d2["north_star"]["pairwise_10kx128"]["from_checkpoint"] is True
+    assert d2["north_star"]["pairwise_10kx128"]["tflops"] \
+        == d1["north_star"]["pairwise_10kx128"]["tflops"]
+
+
 @pytest.mark.skipif(sys.platform == "win32", reason="POSIX signals")
 def test_sigterm_flushes_final_line():
     p = subprocess.Popen([sys.executable, BENCH], stdout=subprocess.PIPE,
