@@ -141,15 +141,22 @@ def _bench_brute_force():
     return qps, recall, profile
 
 
-def _bench_ivf_pq(rows=None):
-    """North-star config #4: QPS@recall-0.95, DEEP-10M-class."""
+def _bench_ivf_pq(rows=None, nq=None, on_point=None):
+    """North-star config #4: QPS@recall-0.95, DEEP-10M-class.
+
+    The refine-ratio ladder below is THE flagship search policy — consumed
+    by both the bench ladder and ``scripts/ivf_pq_10m.py`` (full-scale
+    validation), so it lives exactly once.  ``nq`` bounds the query
+    subsample (CPU full-scale runs); ``on_point`` is a per-sweep-point
+    progress callback for multi-hour runs.
+    """
     import jax.numpy as jnp
     import numpy as np
 
     from ann import best_at_recall, ground_truth, make_clustered, sweep_ivf_pq
     from raft_tpu.neighbors import ivf_pq
 
-    n, d, nq = rows or PQ_ROWS, 96, 10_000
+    n, d, nq = rows or PQ_ROWS, 96, nq or 10_000
     n_clusters = max(64, n // 1000)
     # explicit bench config (not the CLI default): 4096 lists at 10M keeps
     # the (160k-trainset, n_lists) balanced-fit distance matrix ~2.6 GB so
@@ -194,6 +201,8 @@ def _bench_ivf_pq(rows=None):
                            refine_dataset=db_dev, refine_ratio=ratio)
         for pt in pts:
             pt["refine_ratio"] = ratio
+            if on_point:
+                on_point(pt)
         curve += pts
         if best_at_recall(pts, RECALL_FLOOR) is not None:
             break
@@ -205,9 +214,11 @@ def _bench_ivf_pq(rows=None):
                            refine_dataset=db_dev, refine_ratio=ratios[-1])
         for pt in pts:
             pt["refine_ratio"] = ratios[-1]
+            if on_point:
+                on_point(pt)
         curve += pts
     best = best_at_recall(curve, RECALL_FLOOR)
-    return {"rows": n, "dim": d, "n_lists": n_lists, "pq_dim": d // 2,
+    return {"rows": n, "dim": d, "nq": nq, "n_lists": n_lists, "pq_dim": d // 2,
             "build_s": round(build_s, 1), "peak_device_mb": peak_mb,
             "curve": curve,
             "qps_at_recall95": None if best is None else best["qps"],
@@ -352,6 +363,38 @@ def _bench_ivf_flat_kmeans(rows=None):
 # ---------------------------------------------------------------------------
 
 PROBE_TIMEOUT_S = float(os.environ.get("RAFT_BENCH_PROBE_TIMEOUT_S", 180))
+
+ANCHORS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "bench", "anchors.json")
+
+
+def _load_anchor() -> dict:
+    """External (A100) anchor for the north-star ratio.  No trustworthy
+    number is available offline (BASELINE.md 'External A100 anchor'), so
+    the default records that fact machine-readably instead of an empty
+    dict the reader must interpret; a later sourced ``bench/anchors.json``
+    flips it to ratios without code changes."""
+    try:
+        with open(ANCHORS) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {"available": False,
+                "note": "no offline A100 QPS@recall0.95 source in-image; "
+                        "see BASELINE.md 'External A100 anchor'"}
+
+
+def _anchor_report(north_star: dict) -> dict:
+    anchor = _load_anchor()
+    if not anchor.get("available"):
+        return anchor
+    out = {"available": True, "source": anchor.get("source")}
+    for name, target in (anchor.get("configs") or {}).items():
+        res = north_star.get(name)
+        qps = res.get("qps_at_recall95") if isinstance(res, dict) else None
+        if qps and target:
+            out[name] = {"anchor_qps": target,
+                         "vs_anchor": round(qps / target, 3)}
+    return out
 
 _PROBE_SRC = """
 import os, time
@@ -546,6 +589,7 @@ def main() -> None:
                 if isinstance(res, dict) else res
                 for name, res in state["north_star"].items()
             },
+            "anchor": _anchor_report(state["north_star"]),
         }
         if state["error"]:
             line["error"] = state["error"]
@@ -716,6 +760,7 @@ def main() -> None:
                 tmp = HISTORY + ".tmp"
                 with open(tmp, "w") as f:
                     json.dump(hist, f)
+                    f.write("\n")
                 os.replace(tmp, HISTORY)
             except OSError:
                 pass

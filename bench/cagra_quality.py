@@ -103,6 +103,7 @@ def main() -> None:
 
     with open(OUT, "w") as f:
         json.dump(results, f, indent=1)
+        f.write("\n")
     print(f"wrote {OUT}", file=sys.stderr)
 
 

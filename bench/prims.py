@@ -96,6 +96,7 @@ def ratchet(record: bool, ran_suites) -> int:
             del best[key]
         with open(HISTORY, "w") as f:
             json.dump(hist, f, indent=1, sort_keys=True)
+            f.write("\n")
         print(f"ratchet: {len(_results)} cases vs {HISTORY} "
               f"[{backend}], {regressions} regression(s)", file=sys.stderr)
     return regressions

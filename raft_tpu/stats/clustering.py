@@ -107,42 +107,74 @@ def kl_divergence(p, q):
 def silhouette_score(x, labels, n_clusters: Optional[int] = None, batch_size: Optional[int] = None):
     """Mean silhouette coefficient (``silhouette_score.cuh`` + batched variant).
 
-    Per-sample mean distance to each cluster via one pairwise-distance matmul
-    block + segment reduction; ``batch_size`` bounds the distance tile exactly
-    like ``detail/batched/silhouette_score.cuh``.
+    Per-sample mean distance to each cluster via pairwise-distance matmul
+    tiles folded into per-cluster sums.  With ``batch_size`` the distance
+    matrix is chunked along **both** axes (the ``detail/batched/
+    silhouette_score.cuh:214-227`` double loop): each ``(c, c)`` tile is
+    reduced to ``(c, n_clusters)`` cluster sums before the next tile is
+    formed, so peak memory is ``O(c² + c·k)`` — never ``O(c·n)`` — and 1M-row
+    corpora stream through a fixed-size working set.
     """
     x = wrap_array(x, ndim=2)
     y = wrap_array(labels, ndim=1).astype(jnp.int32)
-    n = x.shape[0]
+    n, dim = x.shape
     if n_clusters is None:
         n_clusters = int(jnp.max(y)) + 1
     counts = jnp.zeros((n_clusters,), jnp.float32).at[y].add(1.0)
-    onehot = jax.nn.one_hot(y, n_clusters, dtype=jnp.float32)  # (n, k)
 
-    def tile_stats(xb):
-        # Euclidean distances from tile rows to all points → (b, n)
-        sq = jnp.sum(xb * xb, axis=1, keepdims=True) + jnp.sum(x * x, axis=1)[None, :] \
-             - 2.0 * jnp.matmul(xb, x.T, preferred_element_type=jnp.float32)
-        d = jnp.sqrt(jnp.maximum(sq, 0.0))
-        # sum of distances to each cluster: (b, k)
-        return jnp.matmul(d, onehot, preferred_element_type=jnp.float32)
+    def per_sample_s(cluster_dist, yb):
+        """Silhouette per row from its (rows, k) cluster distance sums."""
+        own = counts[yb]
+        own_dist = jnp.take_along_axis(cluster_dist, yb[:, None], axis=1)[:, 0]
+        a = jnp.where(own > 1, own_dist / jnp.maximum(own - 1, 1.0), 0.0)
+        mean_other = cluster_dist / jnp.maximum(counts[None, :], 1.0)
+        mean_other = jnp.where(jax.nn.one_hot(yb, n_clusters, dtype=bool),
+                               jnp.inf, mean_other)
+        b = jnp.min(mean_other, axis=1)
+        return jnp.where(own > 1,
+                         (b - a) / jnp.maximum(jnp.maximum(a, b), 1e-12), 0.0)
 
     if batch_size is None or batch_size >= n:
-        cluster_dist = tile_stats(x)
-    else:
-        pad = (-n) % batch_size
-        xp = jnp.concatenate([x, jnp.zeros((pad, x.shape[1]), x.dtype)])
-        tiles = xp.reshape(-1, batch_size, x.shape[1])
-        cluster_dist = jax.lax.map(tile_stats, tiles).reshape(-1, n_clusters)[:n]
+        onehot = jax.nn.one_hot(y, n_clusters, dtype=jnp.float32)  # (n, k)
+        sq = jnp.sum(x * x, axis=1, keepdims=True) + jnp.sum(x * x, axis=1)[None, :] \
+             - 2.0 * jnp.matmul(x, x.T, preferred_element_type=jnp.float32)
+        d = jnp.sqrt(jnp.maximum(sq, 0.0))
+        cluster_dist = jnp.matmul(d, onehot, preferred_element_type=jnp.float32)
+        return jnp.mean(per_sample_s(cluster_dist, y))
 
-    own = counts[y]
-    own_dist = jnp.take_along_axis(cluster_dist, y[:, None], axis=1)[:, 0]
-    a = jnp.where(own > 1, own_dist / jnp.maximum(own - 1, 1.0), 0.0)
-    mean_other = cluster_dist / jnp.maximum(counts[None, :], 1.0)
-    mean_other = jnp.where(jax.nn.one_hot(y, n_clusters, dtype=bool), jnp.inf, mean_other)
-    b = jnp.min(mean_other, axis=1)
-    s = jnp.where(own > 1, (b - a) / jnp.maximum(jnp.maximum(a, b), 1e-12), 0.0)
-    return jnp.mean(s)
+    c = batch_size
+    pad = (-n) % c
+    xp = jnp.concatenate([x, jnp.zeros((pad, dim), x.dtype)])
+    # padded points carry label == n_clusters: one_hot maps it to an
+    # all-zero row, so they contribute nothing as columns; as rows they
+    # are masked out of the mean below
+    yp = jnp.concatenate([y, jnp.full((pad,), n_clusters, jnp.int32)])
+    xt = xp.reshape(-1, c, dim)                                   # (T, c, d)
+    nt = jnp.sum(xt * xt, axis=2)                                 # (T, c)
+    yt = yp.reshape(-1, c)
+
+    def row_tile(args):
+        xb, xbn, yb = args
+
+        def col_step(acc, col):
+            xc, xcn, yc = col
+            sq = xbn[:, None] + xcn[None, :] \
+                 - 2.0 * jnp.matmul(xb, xc.T,
+                                    preferred_element_type=jnp.float32)
+            d = jnp.sqrt(jnp.maximum(sq, 0.0))                    # (c, c)
+            # one-hot built per column tile: an up-front (n, k) matrix
+            # would be the O(n·k) allocation this path exists to avoid
+            ohc = jax.nn.one_hot(yc, n_clusters, dtype=jnp.float32)
+            return acc + jnp.matmul(
+                d, ohc, preferred_element_type=jnp.float32), None
+
+        acc, _ = jax.lax.scan(
+            col_step, jnp.zeros((c, n_clusters), jnp.float32), (xt, nt, yt))
+        valid = yb < n_clusters
+        s = per_sample_s(acc, jnp.minimum(yb, n_clusters - 1))
+        return jnp.sum(jnp.where(valid, s, 0.0))
+
+    return jnp.sum(jax.lax.map(row_tile, (xt, nt, yt))) / n
 
 
 class IC_Type(enum.Enum):

@@ -1,0 +1,72 @@
+"""Flagship IVF-PQ at its real scale: 10M×96 ``build_chunked`` + laddered
+search (VERDICT r4 next #5).
+
+``bench.py`` defaults ``PQ_ROWS=10_000_000`` but no executed run had ever
+used it — the r4 validation stopped at 1M (where it found the
+refine-ratio null-metric bug; this run either validates or falsifies that
+ladder at the scale it was designed for).  On CPU the build phase is
+accepted at full cost while search validation is bounded to a query
+subsample (``--nq``, default 1000).  On TPU (no ``--cpu``) the full 10k
+query set is used.
+
+Delegates to ``bench._bench_ivf_pq`` — the ladder policy lives exactly
+once, so this artifact is evidence about the same code the bench ladder
+runs.  Writes sweep-point progress JSON lines and a final backend-stamped
+artifact to ``bench/IVF_PQ_10M_<BACKEND>.json``.
+"""
+
+import argparse
+import datetime
+import json
+import os
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+sys.path.insert(0, os.path.join(_ROOT, "bench"))
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.expanduser("~/.cache/raft_tpu_jax"))
+
+
+def log(**kw):
+    print(json.dumps(kw), flush=True)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--rows", type=int, default=10_000_000)
+    ap.add_argument("--nq", type=int, default=None,
+                    help="query count (default: 1000 on cpu, 10000 else)")
+    args = ap.parse_args()
+
+    import jax
+
+    from _platform import pin_backend
+
+    pin_backend(sys.argv)
+
+    import bench
+
+    backend = jax.default_backend()
+    nq = args.nq or (1000 if backend == "cpu" else 10_000)
+    out_path = os.path.join(_ROOT, "bench", f"IVF_PQ_10M_{backend.upper()}.json")
+
+    log(stage="start", rows=args.rows, nq=nq, backend=backend)
+    t0 = time.time()
+    res = bench._bench_ivf_pq(rows=args.rows, nq=nq,
+                              on_point=lambda pt: log(stage="sweep", **pt))
+    art = {**res, "backend": backend,
+           "date": datetime.date.today().isoformat(),
+           "total_s": round(time.time() - t0, 1)}
+    with open(out_path, "w") as f:
+        json.dump(art, f, indent=1)
+        f.write("\n")
+    log(stage="done", out=out_path, build_s=art["build_s"],
+        qps_at_recall95=art["qps_at_recall95"], best=art["best"])
+
+
+if __name__ == "__main__":
+    main()
