@@ -30,6 +30,12 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                       os.path.expanduser("~/.cache/raft_tpu_jax"))
 
 import jax
+
+from _platform import pin_backend
+
+# MUST precede any backend use (axon sitecustomize overrides the env var)
+pin_backend(sys.argv)
+
 import jax.numpy as jnp
 import numpy as np
 

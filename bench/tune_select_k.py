@@ -27,6 +27,14 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                       os.path.expanduser("~/.cache/raft_tpu_jax"))
 
 import jax
+
+from _platform import pin_backend
+
+# MUST precede any backend use: a bare JAX_PLATFORMS env var is overridden
+# by the axon plugin's sitecustomize, and an unpinned drill process dialing
+# the (possibly wedged) tunnel is the documented wedge trigger
+pin_backend(sys.argv)
+
 import jax.numpy as jnp
 
 from _timing import timeit as _time
@@ -40,21 +48,81 @@ GRID_K = [8, 32, 64, 128]
 CANDIDATES = [SelectAlgo.kTopK, SelectAlgo.kPartialBitonic, SelectAlgo.kBinSelect]
 
 
+def bucket_key(rows: int, cols: int, k: int) -> str:
+    """The table/checkpoint bucket id — single home (the resume filter and
+    the loop body must never desync on the key scheme)."""
+    return f"{rows.bit_length()}:{cols.bit_length()}:{k.bit_length()}"
+
+
+def kernel_sha() -> str:
+    """Hash of the kernel + dispatch sources the table's timings depend
+    on.  Recorded in the sidecar so "tuned against kernels that no longer
+    exist" (the r3→r4 fori_loop staleness) is mechanically detectable, and
+    used to scope the resume checkpoint."""
+    import hashlib
+
+    root = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+    h = hashlib.sha256()
+    for rel in ("raft_tpu/ops/pallas/select_k.py",
+                "raft_tpu/ops/bin_select.py",
+                "raft_tpu/matrix/select_k.py"):
+        with open(os.path.join(root, rel), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
 def main() -> None:
     quick = "--quick" in sys.argv
     rows_grid = [256, 2048] if quick else GRID_ROWS
     # quick mode keeps one short and one long column count (slicing the
     # grid would silently drop the long-row buckets that matter most)
     cols_grid = [1024, 16384] if quick else GRID_COLS
+    sha = kernel_sha()
+    backend = jax.default_backend()
+
+    # resume checkpoint: the grid takes many fresh-compile minutes on a
+    # tunnel that has wedged mid-step before — every decided bucket is
+    # flushed immediately, and a re-run (queue attempt 2) skips buckets
+    # already decided under the SAME backend + kernel sources
+    ckpt_path = os.path.join(
+        "/tmp", f"tune_select_k.{backend}.u{os.getuid()}.partial.json")
     table = {}
+    try:
+        with open(ckpt_path) as f:
+            prior = json.load(f)
+        if prior.get("backend") == backend and prior.get("kernel_sha") == sha:
+            table = prior.get("table", {})
+            print(f"resuming: {len(table)} buckets from checkpoint",
+                  file=sys.stderr)
+    except (OSError, ValueError):
+        pass
+
+    warned = []
+
+    def flush_ckpt():
+        try:
+            with open(ckpt_path + ".tmp", "w") as f:
+                json.dump({"backend": backend, "kernel_sha": sha,
+                           "table": table}, f)
+            os.replace(ckpt_path + ".tmp", ckpt_path)
+        except OSError as e:
+            # a silently-dead checkpoint would defeat the wedge-resume
+            # feature exactly when it matters — warn once, keep tuning
+            if not warned:
+                warned.append(True)
+                print(f"WARN: checkpoint flush failing ({e}); a mid-run "
+                      f"kill will lose progress", file=sys.stderr)
+
     key0 = jax.random.PRNGKey(0)
     for rows in rows_grid:
         for cols in cols_grid:
+            pending = [k for k in GRID_K
+                       if k < cols and bucket_key(rows, cols, k) not in table]
+            if not pending:
+                continue
             x = jax.block_until_ready(
                 jax.random.normal(key0, (rows, cols), jnp.float32))
-            for k in GRID_K:
-                if k >= cols:
-                    continue
+            for k in pending:
                 best_algo, best_t = None, float("inf")
                 for algo in CANDIDATES:
                     if algo is SelectAlgo.kPartialBitonic and k > 64:
@@ -69,9 +137,8 @@ def main() -> None:
                         best_algo, best_t = algo, t
                 if best_algo is None:
                     continue
-                bucket = (f"{rows.bit_length()}:{cols.bit_length()}"
-                          f":{k.bit_length()}")
-                table[bucket] = best_algo.value
+                table[bucket_key(rows, cols, k)] = best_algo.value
+                flush_ckpt()
                 print(f"rows={rows:6d} cols={cols:7d} k={k:4d} → "
                       f"{best_algo.name} ({best_t * 1e3:.2f} ms)")
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
@@ -89,9 +156,15 @@ def main() -> None:
     import datetime
 
     with open(out.replace(".json", ".meta.json"), "w") as f:
-        json.dump({"backend": jax.default_backend(),
+        json.dump({"backend": backend,
                    "date": datetime.date.today().isoformat(),
+                   "kernel_sha": sha,
                    "n_entries": len(table)}, f)
+        f.write("\n")
+    try:
+        os.remove(ckpt_path)  # spent: the final table supersedes it
+    except OSError:
+        pass
     print(f"wrote {len(table)} entries → {os.path.normpath(out)}")
 
 

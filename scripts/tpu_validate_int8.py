@@ -17,6 +17,13 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                       os.path.expanduser("~/.cache/raft_tpu_jax"))
 
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "bench"))
+from _platform import pin_backend
+
+# MUST precede any backend use (axon sitecustomize overrides the env var)
+pin_backend(sys.argv)
+
 import jax.numpy as jnp
 import numpy as np
 

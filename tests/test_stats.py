@@ -161,6 +161,19 @@ class TestNeighborhood:
         t = float(stats.trustworthiness_score(x, e, n_neighbors=5))
         assert t < 0.95
 
+    def test_trustworthiness_colchunked_matches(self, rng):
+        # database axis streamed in chunks (col_batch_size): must agree
+        # exactly with the single-strip path — n not a multiple of either
+        # tile size so both padding paths are exercised
+        n = 533
+        x = rng.standard_normal((n, 12)).astype(np.float32)
+        e = (x[:, :3] + 0.3 * rng.standard_normal((n, 3))).astype(np.float32)
+        t1 = float(stats.trustworthiness_score(x, e, n_neighbors=7))
+        t2 = float(stats.trustworthiness_score(x, e, n_neighbors=7,
+                                               batch_size=200,
+                                               col_batch_size=100))
+        assert t2 == pytest.approx(t1, abs=1e-6)
+
 
 @pytest.mark.skipif(__import__("os").environ.get("RAFT_RUN_SLOW") != "1",
                     reason="100k-row O(n^2) sweep; set RAFT_RUN_SLOW=1")
