@@ -181,6 +181,8 @@ def build_chunked(dataset, params: Optional[IvfFlatIndexParams] = None, *,
     data = jnp.zeros((p.n_lists, cap, d), dtype)
     ids_slab = jnp.full((p.n_lists, cap), -1, jnp.int32)
     counts = jnp.zeros((p.n_lists,), jnp.int32)
+    from ..core.logging import default_logger
+
     for lo, hi, xc_h, idc_h in prefetch_chunks(dataset, chunk_rows,
                                                source_ids):
         xc = jnp.asarray(xc_h, dtype)
@@ -189,6 +191,10 @@ def build_chunked(dataset, params: Optional[IvfFlatIndexParams] = None, *,
         (data, ids_slab), counts = scatter_append(
             (data, ids_slab), counts, labels, (xc, idc),
             n_lists=p.n_lists, cap=cap)
+        # liveness signal for multi-hour full-scale builds
+        # (RAFT_TPU_LOG_LEVEL=DEBUG)
+        default_logger().debug("build_chunked: rows %d-%d of %d ingested",
+                               lo, hi, n)
     norms = jnp.sum(data.astype(jnp.float32) ** 2, axis=2)
     return IvfFlatIndex(centroids, data, ids_slab, counts, norms, p.metric)
 

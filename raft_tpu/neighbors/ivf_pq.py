@@ -422,6 +422,8 @@ def build_chunked(dataset, params: Optional[IvfPqIndexParams] = None, *,
     cnorms = jnp.zeros((p.n_lists, cap), jnp.float32)
     ids_slab = jnp.full((p.n_lists, cap), -1, jnp.int32)
     counts = jnp.zeros((p.n_lists,), jnp.int32)
+    from ..core.logging import default_logger
+
     for lo, hi, xc_h, idc_h in prefetch_chunks(dataset, chunk_rows,
                                                source_ids):
         xc = jnp.asarray(xc_h)
@@ -432,6 +434,10 @@ def build_chunked(dataset, params: Optional[IvfPqIndexParams] = None, *,
         (codes, cnorms, ids_slab), counts = scatter_append(
             (codes, cnorms, ids_slab), counts, labels,
             (ch_codes, ch_norms, idc), n_lists=p.n_lists, cap=cap)
+        # multi-hour full-scale builds need a liveness signal
+        # (RAFT_TPU_LOG_LEVEL=DEBUG): rows ingested, not per-list detail
+        default_logger().debug("build_chunked: rows %d-%d of %d encoded",
+                               lo, hi, n)
 
     index = IvfPqIndex(centroids, codebooks, codes, cnorms, ids_slab,
                        counts, p.metric)
