@@ -185,19 +185,34 @@ def _bench_ivf_pq(rows=None, nq=None, on_point=None):
     peak_mb = (round(mt.peak_bytes / 1e6, 1)
                if mt.peak_bytes is not None else None)
 
-    # Escalate refine_ratio, not probes: at ≥1M rows the raw PQ ranking
-    # saturates with probes (measured 2026-07-31 at 300k/1M: raw recall
-    # 0.7261→0.7276 from 16→64 probes) and the recall ceiling is set by
-    # whether true neighbors make the refine shortlist — ratio 4 caps at
-    # ~0.94, ratio 8 ~0.96, ratio 16 ~0.977.  Stop at the first ratio that
-    # clears the floor: at equal recall a higher ratio only spends more
-    # select_k/refine work.
+    # The escalation PLAN is scale-dependent, set by measured regimes
+    # (recall behavior is backend-independent; all numbers 2026-07-31):
+    #   * ≤300k: probes AND ratio both matter — full ladder from ratio 4.
+    #   * ~1M: shortlist-bound — raw PQ recall saturates with probes
+    #     (0.7261→0.7276 from 16→64) and the ceiling is set by the refine
+    #     ratio (4 caps ~0.94, 8 ~0.96, 16 ~0.977); escalate ratio.
+    #   * ≥10M: PROBE-bound — ratio 16 ≈ ratio 8 recall at every probe
+    #     count ≤32 (Δ ≤ 0.0003, bench/IVF_PQ_10M_CPU.json; QPS deltas
+    #     there are 1-core CPU noise — one point even reads faster at 16).
+    #     The measured floor crossing is probes 64 AT RATIO 16
+    #     (recall 0.9689); the ratio-8 wide-probe leg below extrapolates
+    #     that crossing from the recall equivalence and is confirmed or
+    #     corrected by the first run of this plan. Escalate probes at
+    #     ratio 8 (cheaper refine), ratio-16 wide stage as guard.
+    # Stop at the first stage that clears the floor: past it, more work
+    # only buys recall the gate doesn't ask for.  The expected-crossing
+    # point (64) ends stage 1 so the costliest sweep point (128 probes)
+    # is only paid when 64 misses.
+    if n >= 10_000_000:
+        plan = [(8, [16, 32, 64]), (8, [128]), (16, [64, 128])]
+    elif n >= 1_000_000:
+        plan = [(8, [4, 8, 16, 32]), (16, [4, 8, 16, 32]), (16, [64, 128])]
+    else:
+        plan = [(4, [4, 8, 16, 32]), (8, [4, 8, 16, 32]),
+                (16, [4, 8, 16, 32]), (16, [64, 128])]
     curve = []
-    # ratio 4 measurably cannot reach the floor at ≥1M rows — skip its
-    # known-wasted sweep there (watchdog/budget pressure at full scale)
-    ratios = (8, 16) if n >= 1_000_000 else (4, 8, 16)
-    for ratio in ratios:
-        pts = sweep_ivf_pq(index, q, gt, K, [4, 8, 16, 32],
+    for ratio, grid in plan:
+        pts = sweep_ivf_pq(index, q, gt, K, grid,
                            refine_dataset=db_dev, refine_ratio=ratio)
         for pt in pts:
             pt["refine_ratio"] = ratio
@@ -206,17 +221,6 @@ def _bench_ivf_pq(rows=None, nq=None, on_point=None):
         curve += pts
         if best_at_recall(pts, RECALL_FLOOR) is not None:
             break
-    if best_at_recall(curve, RECALL_FLOOR) is None:
-        # probe-bound regime (small row counts: 32 probes may cover too few
-        # lists for ANY shortlist to contain the true neighbors) — one
-        # last probe escalation at the widest shortlist
-        pts = sweep_ivf_pq(index, q, gt, K, [64, 128],
-                           refine_dataset=db_dev, refine_ratio=ratios[-1])
-        for pt in pts:
-            pt["refine_ratio"] = ratios[-1]
-            if on_point:
-                on_point(pt)
-        curve += pts
     best = best_at_recall(curve, RECALL_FLOOR)
     return {"rows": n, "dim": d, "nq": nq, "n_lists": n_lists, "pq_dim": d // 2,
             "build_s": round(build_s, 1), "peak_device_mb": peak_mb,

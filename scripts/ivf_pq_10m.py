@@ -12,7 +12,8 @@ query set is used.
 Delegates to ``bench._bench_ivf_pq`` — the ladder policy lives exactly
 once, so this artifact is evidence about the same code the bench ladder
 runs.  Writes sweep-point progress JSON lines and a final backend-stamped
-artifact to ``bench/IVF_PQ_10M_<BACKEND>.json``.
+artifact to ``bench/IVF_PQ_<scale>_<BACKEND>.json`` (``10M`` only for an
+exactly-10M-row run; other scales are named by row count).
 """
 
 import argparse
@@ -52,7 +53,12 @@ def main() -> None:
 
     backend = jax.default_backend()
     nq = args.nq or (1000 if backend == "cpu" else 10_000)
-    out_path = os.path.join(_ROOT, "bench", f"IVF_PQ_10M_{backend.upper()}.json")
+    # the canonical 10M name is reserved for exactly-full-scale runs — a
+    # reduced smoke OR an enlarged run must never overwrite the real
+    # artifact under the wrong label
+    scale = "10M" if args.rows == 10_000_000 else str(args.rows)
+    out_path = os.path.join(_ROOT, "bench",
+                            f"IVF_PQ_{scale}_{backend.upper()}.json")
 
     log(stage="start", rows=args.rows, nq=nq, backend=backend)
     t0 = time.time()
