@@ -316,10 +316,23 @@ def _bench_ivf_flat_kmeans(rows=None):
     kp = KMeansParams(n_clusters=n_lists, max_iter=10, seed=0)
     np.asarray(kmeans_balanced_fit(db, kp)[0])
     t0 = time.time()
-    centroids, _, _ = kmeans_balanced_fit(db, kp)
+    centroids, _, inertia = kmeans_balanced_fit(db, kp)
     np.asarray(centroids)  # completion barrier (see ann.fetch)
     fit_s = time.time() - t0
     kmeans_rows_s = n * kp.max_iter / fit_s
+
+    # bf16-assignment training tier (single-pass MXU gemm): reported as its
+    # own key — the exact-path number above stays ratchet-comparable.
+    # Inertia ratio quantifies the quality cost of the fast tier in-line
+    kpf = KMeansParams(n_clusters=n_lists, max_iter=10, seed=0,
+                       balanced_assign_precision="bf16")
+    np.asarray(kmeans_balanced_fit(db, kpf)[0])
+    t0 = time.time()
+    cf, _, inertia_f = kmeans_balanced_fit(db, kpf)
+    np.asarray(cf)
+    fit_f_s = time.time() - t0
+    kmeans_bf16_rows_s = n * kpf.max_iter / fit_f_s
+    inertia_ratio = float(inertia_f) / max(float(inertia), 1e-30)
 
     t0 = time.time()
     index = ivf_flat.build(db, ivf_flat.IvfFlatIndexParams(n_lists=n_lists,
@@ -332,6 +345,8 @@ def _bench_ivf_flat_kmeans(rows=None):
     return {"rows": n, "dim": d, "n_lists": n_lists,
             "kmeans_fit_s": round(fit_s, 1),
             "kmeans_rows_per_s": round(kmeans_rows_s, 0),
+            "kmeans_bf16_rows_per_s": round(kmeans_bf16_rows_s, 0),
+            "kmeans_bf16_inertia_ratio": round(inertia_ratio, 4),
             "build_s": round(build_s, 1), "curve": curve,
             "qps_at_recall95": None if best is None else best["qps"],
             "best": best}
@@ -555,6 +570,7 @@ _RATCHET_KEYS = (
     ("ivf_flat_kmeans_1m", "qps_at_recall95", "ivf_flat_qps95"),
     ("pairwise_10kx128", "tflops", "pairwise_tflops"),
     ("ivf_flat_kmeans_1m", "kmeans_rows_per_s", "kmeans_rows_s"),
+    ("ivf_flat_kmeans_1m", "kmeans_bf16_rows_per_s", "kmeans_bf16_rows_s"),
 )
 
 

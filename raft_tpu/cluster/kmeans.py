@@ -60,6 +60,12 @@ class KMeansParams:
     init: str = "kmeans++"  # "kmeans++" | "random"
     balanced_penalty: float = 1.0   # soft size penalty during balanced training
     balanced_max_ratio: float = 2.0  # hard cap = ratio · n/k for balanced lists
+    # "highest" = exact 3-pass gemm for training assignments (default);
+    # "bf16" = single-pass MXU gemm (~3x assignment rate) for the balanced
+    # TRAINING loop only — the final capped assignment and the returned
+    # inertia always use the exact gemm, so the hard size bound and the
+    # reported quality are precision-independent
+    balanced_assign_precision: str = "highest"  # "highest" | "bf16"
 
 
 def _centroid_dtype(x):
@@ -189,6 +195,11 @@ def kmeans_fit(
     p = params or KMeansParams()
     x = wrap_array(x, ndim=2, name="x")
     expects(p.n_clusters <= x.shape[0], "n_clusters exceeds n_rows")
+    # balanced-only knob (its name says so): reject rather than silently
+    # run the plain fit at a precision the caller didn't get
+    expects(p.balanced_assign_precision == "highest",
+            "balanced_assign_precision applies to kmeans_balanced_fit* "
+            "only; the plain fit always assigns at Precision.HIGHEST")
     w = None
     if sample_weight is not None:
         w = jnp.asarray(sample_weight, jnp.float32)
@@ -286,7 +297,8 @@ def kmeans_transform(x, centroids, *, res=None) -> jax.Array:
 # Balanced variant — the IVF coarse quantizer.
 # --------------------------------------------------------------------------
 
-def _assign_balanced(x, c, counts, penalty, n_per):
+def _assign_balanced(x, c, counts, penalty, n_per,
+                     precision=jax.lax.Precision.HIGHEST):
     """Assignment with multiplicative size penalty:
     ``cost = d² · (1 + λ·size/target)``.
 
@@ -295,7 +307,7 @@ def _assign_balanced(x, c, counts, penalty, n_per):
     migrate to less-crowded neighbors — additive penalties either do nothing
     (scale too small) or shuffle points across unrelated clusters (too
     large)."""
-    d2 = sq_l2(x, c)
+    d2 = sq_l2(x, c, precision=precision)
     cost = d2 * (1.0 + penalty * counts[None, :] / jnp.maximum(n_per, 1.0))
     labels = jnp.argmin(cost, axis=1)
     real = jnp.take_along_axis(d2, labels[:, None], axis=1)[:, 0]
@@ -369,8 +381,9 @@ def capped_assign_room(x, centroids, room):
     return _capped_assign_impl(x, centroids, jnp.asarray(room, jnp.int32))
 
 
-@partial(jax.jit, static_argnames=("k", "max_iter", "cap"))
-def _balanced_fit_impl(x, key, k: int, max_iter: int, penalty: float, cap: int):
+@partial(jax.jit, static_argnames=("k", "max_iter", "cap", "precision"))
+def _balanced_fit_impl(x, key, k: int, max_iter: int, penalty: float, cap: int,
+                       precision=jax.lax.Precision.HIGHEST):
     n = x.shape[0]
     n_per = jnp.float32(n / k)
     c0 = kmeans_plus_plus_init(key, x, k).astype(jnp.float32)
@@ -378,7 +391,8 @@ def _balanced_fit_impl(x, key, k: int, max_iter: int, penalty: float, cap: int):
 
     def body(it, carry):
         c, counts_s, _ = carry
-        labels, d2 = _assign_balanced(x, c, counts_s, penalty, n_per)
+        labels, d2 = _assign_balanced(x, c, counts_s, penalty, n_per,
+                                      precision)
         sums, cnts = _update(x, labels, k)
         c2 = _new_centroids(sums, cnts, c)
         # revive genuinely empty clusters (otherwise frozen forever): slot
@@ -424,9 +438,14 @@ def kmeans_balanced_fit_predict(x, params: Optional[KMeansParams] = None, *, res
         p.balanced_max_ratio >= 1.0,
         f"balanced_max_ratio={p.balanced_max_ratio} < 1 cannot hold all points",
     )
+    expects(p.balanced_assign_precision in ("highest", "bf16"),
+            f"balanced_assign_precision={p.balanced_assign_precision!r} (want highest|bf16)")
     key = jax.random.PRNGKey(p.seed)
+    precision = (jax.lax.Precision.DEFAULT if p.balanced_assign_precision == "bf16"
+                 else jax.lax.Precision.HIGHEST)
     return _balanced_fit_impl(
-        x, key, p.n_clusters, p.max_iter, p.balanced_penalty, _balanced_cap(p, x.shape[0])
+        x, key, p.n_clusters, p.max_iter, p.balanced_penalty,
+        _balanced_cap(p, x.shape[0]), precision=precision
     )
 
 

@@ -105,20 +105,24 @@ def sq_norm_rows(x: jax.Array) -> jax.Array:
     return jnp.sum(x * x, axis=-1)
 
 
-def sq_l2(x: jax.Array, y: jax.Array) -> jax.Array:
-    """Exact squared-L2 matrix (m, n) in f32 — THE shared recipe.
+def sq_l2(x: jax.Array, y: jax.Array, *,
+          precision=jax.lax.Precision.HIGHEST) -> jax.Array:
+    """Squared-L2 matrix (m, n) in f32 — THE shared recipe.
 
-    One place owns the precision-critical gemm: f32 accumulation +
-    ``Precision.HIGHEST`` (default bf16 MXU passes are coarser than
-    neighbor/centroid gaps) + cancellation clamp.  Everything needing raw
-    squared distances (kmeans assignment, capacity assignment, IVF) must call
-    this, not re-derive it.
+    One place owns the distance gemm: f32 accumulation + a cancellation
+    clamp, at ``Precision.HIGHEST`` by default (single bf16 MXU passes are
+    coarser than neighbor/centroid gaps).  Everything needing raw squared
+    distances (kmeans assignment, capacity assignment, IVF) must call
+    this, not re-derive it.  ``precision=Precision.DEFAULT`` opts a caller
+    into the ~3× faster single-pass bf16 MXU gemm where only an argmin
+    over well-separated alternatives is consumed (kmeans *training*
+    assignments — never final/capped assignments or k-NN ranking).
     """
     xf = x.astype(jnp.float32)
     yf = y.astype(jnp.float32)
     dots = jnp.dot(
         x, y.T, preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
+        precision=precision,
     )
     return jnp.maximum(
         sq_norm_rows(xf)[:, None] + sq_norm_rows(yf)[None, :] - 2.0 * dots, 0.0

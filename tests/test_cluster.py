@@ -81,6 +81,31 @@ def test_kmeans_balanced_sizes(rng):
     assert sizes.max() <= 3 * 480 / 8, sizes
 
 
+def test_kmeans_balanced_bf16_assign_tier(rng):
+    """balanced_assign_precision="bf16" speeds the TRAINING gemm only:
+    the returned partition stays valid and the quality (inertia, measured
+    exactly in both cases) stays within a 5% tolerance of the
+    exact-assignment fit — loose enough to hold on TPU, where DEFAULT
+    precision really is bf16 and assignments can flip near ties."""
+    x, _ = _blobs(rng, n=480, d=6, k=3, seed=5)
+    exact = KMeansParams(n_clusters=8, max_iter=30, balanced_penalty=2.0,
+                         seed=0)
+    fast = KMeansParams(n_clusters=8, max_iter=30, balanced_penalty=2.0,
+                        seed=0, balanced_assign_precision="bf16")
+    _, sizes_e, inertia_e = kmeans_balanced_fit(x, exact)
+    _, sizes_f, inertia_f = kmeans_balanced_fit(x, fast)
+    assert np.asarray(sizes_f).sum() == 480
+    assert float(inertia_f) <= float(inertia_e) * 1.05
+
+    with pytest.raises(Exception, match="balanced_assign_precision"):
+        kmeans_balanced_fit(x, KMeansParams(n_clusters=8,
+                                            balanced_assign_precision="bf17"))
+    # the plain fit rejects the balanced-only knob instead of ignoring it
+    with pytest.raises(Exception, match="balanced_assign_precision"):
+        kmeans_fit(x, KMeansParams(n_clusters=8,
+                                   balanced_assign_precision="bf16"))
+
+
 def test_kmeans_balanced_fit_predict(rng):
     x, y = _blobs(rng, n=300, d=5, k=5, seed=13)
     p = KMeansParams(n_clusters=5, max_iter=40, balanced_penalty=0.5, seed=4)
