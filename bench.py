@@ -204,7 +204,11 @@ def _bench_ivf_pq(rows=None, nq=None, on_point=None):
     # point (64) ends stage 1 so the costliest sweep point (128 probes)
     # is only paid when 64 misses.
     if n >= 10_000_000:
-        plan = [(8, [16, 32, 64]), (8, [128]), (16, [64, 128])]
+        # stage order: expected-cheapest crossing first, then the MEASURED
+        # crossing (16, 64) — so a miss on the extrapolated ratio-8 leg
+        # falls back to the confirmed operating point before paying any
+        # 128-probe sweep
+        plan = [(8, [16, 32, 64]), (16, [64]), (8, [128]), (16, [128])]
     elif n >= 1_000_000:
         plan = [(8, [4, 8, 16, 32]), (16, [4, 8, 16, 32]), (16, [64, 128])]
     else:
