@@ -118,6 +118,21 @@ class TestClusteringMetrics:
         s_b = float(stats.silhouette_score(x, y, batch_size=16))
         assert s_b == pytest.approx(s, rel=1e-3)
 
+    def test_silhouette_cluster_reduce_modes_agree(self, rng):
+        # segment (scatter) vs matmul (one-hot) reductions must agree
+        # exactly, on both the dense and the padded batched paths — the
+        # segment branch is what large-k CPU runs rely on
+        n, d, k = 700, 16, 9
+        x = rng.standard_normal((n, d)).astype(np.float32)
+        y = rng.integers(0, k, n).astype(np.int32)
+        vals = [float(stats.silhouette_score(x, y, cluster_reduce=r,
+                                             batch_size=b))
+                for r in ("matmul", "segment") for b in (None, 128)]
+        for v in vals[1:]:
+            assert v == pytest.approx(vals[0], abs=1e-5)
+        with pytest.raises(Exception, match="cluster_reduce"):
+            stats.silhouette_score(x, y, cluster_reduce="scatter")
+
     def test_silhouette_batched_matches_dense(self, rng):
         # n deliberately NOT a multiple of batch_size: padded rows/columns
         # must drop out of both the cluster sums and the mean
