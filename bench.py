@@ -443,13 +443,35 @@ _CONFIGS = (
     ("pairwise", "pairwise_10kx128", _bench_pairwise, 10_000, 1_000, 600),
     ("ivf_pq", "ivf_pq_deep10m_class", _bench_ivf_pq, PQ_ROWS, 100_000, 2700),
     ("cagra", "cagra_1m", _bench_cagra, CAGRA_ROWS, 100_000, 2100),
+    # ivf_flat's cap covers TWO phases (kmeans_balanced fit + the n_probes
+    # sweep) — 1800 s left it the tightest big config and a first-compile
+    # TPU run could hit the watchdog mid-sweep; match ivf_pq's 2700 cap
     ("ivf_flat", "ivf_flat_kmeans_1m", _bench_ivf_flat_kmeans, IF_ROWS,
-     100_000, 1800),
+     100_000, 2700),
 )
 
 
 def _config_row(short: str):
     return next(row for row in _CONFIGS if row[0] == short)
+
+
+def _source_hash() -> str:
+    """Content hash of the measurement code (this file + bench/ann.py),
+    part of the checkpoint scope: a checkpoint written by one version of
+    the sweeps/gates must not replay under another.  Content-based rather
+    than git HEAD so an uncommitted edit also invalidates."""
+    import hashlib
+
+    h = hashlib.sha256()
+    here = os.path.dirname(os.path.abspath(__file__))
+    for path in (os.path.abspath(__file__),
+                 os.path.join(here, "bench", "ann.py")):
+        try:
+            with open(path, "rb") as f:
+                h.update(f.read())
+        except OSError:
+            h.update(b"<unreadable>")
+    return h.hexdigest()[:12]
 
 
 def _config_timeout(short: str) -> float:
@@ -662,9 +684,11 @@ def main() -> None:
     # everything that changes WHAT a config measures must match for a
     # checkpoint to be reusable: backend (cpu smoke vs tpu), the scale
     # knobs (a reduced-rows sanity run must not replay into a record run
-    # and ratchet smoke numbers as 1M-scale), and the fast-path tuning
-    # knobs (an A/B combo is a different measurement)
-    _ckpt_scope = {"backend": state["backend"]}
+    # and ratchet smoke numbers as 1M-scale), the fast-path tuning
+    # knobs (an A/B combo is a different measurement), and the bench
+    # source itself — an edited sweep/gate must re-measure, not replay
+    # stale numbers written by different code
+    _ckpt_scope = {"backend": state["backend"], "src": _source_hash()}
     _ckpt_scope.update({k: os.environ.get(k, "") for k in (
         "RAFT_BENCH_BF_ROWS", "RAFT_BENCH_PQ_ROWS", "RAFT_BENCH_CAGRA_ROWS",
         "RAFT_BENCH_IF_ROWS", "RAFT_BENCH_CUT", "RAFT_BENCH_REFINE_PREC",
@@ -694,8 +718,11 @@ def main() -> None:
         try:
             # post_timeout_kill is run-local metadata (it triggers a wedge
             # re-probe after the config) — replaying it would re-probe, and
-            # possibly falsely abort, a healthy rerun
-            res = {k: v for k, v in res.items() if k != "post_timeout_kill"}
+            # possibly falsely abort, a healthy rerun.  from_checkpoint is
+            # likewise run-local: a replayed result re-saved to persist its
+            # catch-up ``ratcheted`` flag must not bake the marker in.
+            res = {k: v for k, v in res.items()
+                   if k not in ("post_timeout_kill", "from_checkpoint")}
             path = os.path.join(ckpt_dir, short + ".json")
             with open(path + ".tmp", "w") as f:
                 json.dump({"scope": _ckpt_scope, "res": res}, f)
@@ -809,7 +836,6 @@ def main() -> None:
         else:
             res = run_config(short)
             res.pop("config", None)
-            save_ckpt(short, res)
         if short == "brute_force":
             state["qps"] = float(res.get("qps") or 0.0)
             state["recall"] = float(res.get("recall") or 0.0)
@@ -818,11 +844,18 @@ def main() -> None:
         else:
             state["north_star"][name] = res
         state["done"] += 1
-        if not res.get("from_checkpoint"):
-            # a replayed result already ratcheted (history writes are
-            # incremental) — re-ratcheting would re-stamp _meta's date,
-            # relabeling an old measurement as made today
+        if not res.get("ratcheted"):
+            # ratchet BEFORE checkpointing: the old order (save_ckpt, then
+            # ratchet) had a kill window where a measurement was
+            # checkpointed but never entered BENCH_HISTORY — the rerun
+            # replayed it as "already ratcheted" and the number was lost
+            # for good.  The ``ratcheted`` flag rides in the checkpoint:
+            # a replay that carries it is genuinely done (re-ratcheting
+            # would re-stamp _meta's date, relabeling an old measurement
+            # as made today); a replay without it catches up here.
             ratchet(short, res)
+            res["ratcheted"] = True
+            save_ckpt(short, res)
         flush_final()
         if res.get("skipped") == "watchdog_timeout" or \
                 res.get("post_timeout_kill"):

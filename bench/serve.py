@@ -1,0 +1,164 @@
+"""Closed-loop serving benchmark — QPS vs p95 latency for SearchServer.
+
+Sweeps client concurrency over a mixed-shape workload (realistic online
+traffic: mostly single/small queries, occasional bulk) against one
+:class:`raft_tpu.serve.SearchServer`, and reports the headline metric the
+serving runtime exists for: **best sustained QPS whose p95 latency fits
+the budget** (default 50 ms).
+
+Prints one JSON line per sweep point and ONE final JSON line
+``{"metric": "serve_qps_at_p95_budget", "value", "unit", ...}`` in the
+``bench.py`` driver format, plus the server's metrics snapshot (queue
+depth, batch-fill ratio, compile-cache counters) for the round artifact.
+
+Scale knobs (CPU smoke → TPU record):
+  RAFT_BENCH_SERVE_ROWS      index rows            (default 100_000)
+  RAFT_BENCH_SERVE_DIM       vector dim            (default 96)
+  RAFT_BENCH_SERVE_K         neighbors             (default 10)
+  RAFT_BENCH_SERVE_FAMILY    brute_force | ivf_flat (default ivf_flat)
+  RAFT_BENCH_SERVE_SECONDS   seconds per sweep point (default 5)
+  RAFT_BENCH_SERVE_CLIENTS   comma sweep           (default "1,2,4,8,16")
+  RAFT_BENCH_SERVE_BUDGET_MS p95 latency budget    (default 50)
+  RAFT_BENCH_SERVE_LADDER    comma bucket ladder   (default "1,8,64")
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.expanduser("~/.cache/raft_tpu_jax"))
+
+import jax  # noqa: E402
+
+from _platform import pin_backend  # noqa: E402
+
+pin_backend(sys.argv)
+
+import numpy as np  # noqa: E402
+
+ROWS = int(os.environ.get("RAFT_BENCH_SERVE_ROWS", 100_000))
+DIM = int(os.environ.get("RAFT_BENCH_SERVE_DIM", 96))
+K = int(os.environ.get("RAFT_BENCH_SERVE_K", 10))
+FAMILY = os.environ.get("RAFT_BENCH_SERVE_FAMILY", "ivf_flat")
+SECONDS = float(os.environ.get("RAFT_BENCH_SERVE_SECONDS", 5))
+CLIENTS = tuple(int(c) for c in
+                os.environ.get("RAFT_BENCH_SERVE_CLIENTS",
+                               "1,2,4,8,16").split(","))
+BUDGET_MS = float(os.environ.get("RAFT_BENCH_SERVE_BUDGET_MS", 50))
+LADDER = tuple(int(b) for b in
+               os.environ.get("RAFT_BENCH_SERVE_LADDER", "1,8,64").split(","))
+
+# the mixed-shape request mix: point lookups dominate, small batches
+# common, bulk occasional — the traffic the bucket ladder is shaped for
+_SHAPES = (1, 1, 1, 2, 4, 8, 8, 16, 32, 64)
+
+
+def _build_index(db):
+    if FAMILY == "brute_force":
+        import jax.numpy as jnp
+
+        return jnp.asarray(db), None
+    if FAMILY == "ivf_flat":
+        from raft_tpu.neighbors import ivf_flat
+
+        n_lists = max(8, int(np.sqrt(ROWS)))
+        idx = ivf_flat.build(db, ivf_flat.IvfFlatIndexParams(n_lists=n_lists))
+        return idx, ivf_flat.IvfFlatSearchParams(
+            n_probes=max(1, n_lists // 16))
+    raise SystemExit(f"unknown RAFT_BENCH_SERVE_FAMILY={FAMILY!r}")
+
+
+def _sweep_point(srv, n_clients: int, seconds: float, rng_seed: int):
+    """Closed loop: each client thread submits, waits, resubmits, for
+    ``seconds``.  Returns (qps, p95_ms, snapshot-delta)."""
+    stop = threading.Event()
+    done = [0] * n_clients
+    lat0 = srv.metrics.snapshot()
+
+    def client(j):
+        rng = np.random.default_rng(rng_seed + j)
+        while not stop.is_set():
+            rows = int(rng.choice(_SHAPES))
+            q = rng.standard_normal((rows, DIM)).astype(np.float32)
+            try:
+                srv.submit(q, deadline_ms=10 * BUDGET_MS).result(timeout=30)
+                done[j] += 1
+            except Exception:
+                pass  # rejections are counted by the server's metrics
+
+    threads = [threading.Thread(target=client, args=(j,), daemon=True)
+               for j in range(n_clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(seconds)
+    stop.set()
+    for t in threads:
+        t.join(timeout=60)
+    dt = time.perf_counter() - t0
+    snap = srv.metrics.snapshot()
+    return (sum(done) / dt, snap["latency_ms"]["p95"],
+            {"completed_delta": snap["completed"] - lat0["completed"],
+             "rejected_deadline_delta":
+                 snap["rejected_deadline"] - lat0["rejected_deadline"]})
+
+
+def run(seconds: float = SECONDS, clients=CLIENTS) -> dict:
+    """Build index, start server, sweep concurrency; returns the final
+    result dict (also printed as the last JSON line)."""
+    from raft_tpu.serve import SearchServer, ServerConfig
+
+    rng = np.random.default_rng(0)
+    db = rng.standard_normal((ROWS, DIM)).astype(np.float32)
+    index, params = _build_index(db)
+    cfg = ServerConfig(ladder=LADDER, max_wait_ms=1.0,
+                       max_queue=max(256, 32 * max(clients)))
+    srv = SearchServer(index, k=K, params=params, config=cfg)
+    t0 = time.perf_counter()
+    n_exec = srv.warmup()
+    compile_s = time.perf_counter() - t0
+    print(json.dumps({"config": "serve_warmup", "family": srv.family,
+                      "executables": n_exec,
+                      "compile_s": round(compile_s, 2)}), flush=True)
+    srv.start(warmup=False)
+
+    best = {"qps": 0.0, "p95_ms": None, "clients": 0}
+    points = []
+    try:
+        for n in clients:
+            qps, p95, extra = _sweep_point(srv, n, seconds, rng_seed=17 * n)
+            point = {"config": "serve_sweep", "clients": n,
+                     "qps": round(qps, 1), "p95_ms": p95, **extra}
+            points.append(point)
+            print(json.dumps(point), flush=True)
+            if p95 <= BUDGET_MS and qps > best["qps"]:
+                best = {"qps": qps, "p95_ms": p95, "clients": n}
+    finally:
+        srv.stop()
+
+    snap = srv.metrics_snapshot()
+    final = {
+        "metric": "serve_qps_at_p95_budget",
+        "value": round(best["qps"], 1),
+        "unit": f"qps@p95<={BUDGET_MS:g}ms",
+        "clients": best["clients"],
+        "p95_ms": best["p95_ms"],
+        "family": srv.family,
+        "rows": ROWS, "dim": DIM, "k": K, "ladder": list(srv.ladder),
+        "backend": jax.default_backend(),
+        "points": points,
+        "serving_metrics": snap,
+    }
+    print(json.dumps(final), flush=True)
+    return final
+
+
+if __name__ == "__main__":
+    run()

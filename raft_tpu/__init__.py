@@ -20,7 +20,7 @@ from .core import Resources, DeviceResources, default_resources
 _SUBMODULES = (
     "linalg", "matrix", "random", "stats", "distance", "neighbors",
     "cluster", "comms", "sparse", "solver", "spectral", "label", "utils",
-    "io", "ops",
+    "io", "ops", "serve",
 )
 
 

@@ -16,7 +16,8 @@ queries, k)`` — params-first argument order, upstream metric naming
 it is accepted for signature parity and unused).
 """
 
-from . import brute_force, cagra, ivf_flat, ivf_pq  # noqa: F401
+from . import brute_force, cagra, ivf_flat, ivf_pq, serving  # noqa: F401
 from .refine import refine  # noqa: F401
 
-__all__ = ["brute_force", "cagra", "ivf_flat", "ivf_pq", "refine"]
+__all__ = ["brute_force", "cagra", "ivf_flat", "ivf_pq", "refine",
+           "serving"]

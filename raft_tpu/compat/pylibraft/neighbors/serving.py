@@ -1,0 +1,91 @@
+"""Thin serving adapter over :mod:`raft_tpu.serve` in the pylibraft call
+convention (params-first, ``handle=`` accepted for signature parity).
+
+Upstream has no serving surface — cuVS/pylibraft stop at one-shot
+``search()`` — so this module is additive: it lets code already holding a
+compat-built index and compat ``SearchParams`` stand up the online
+runtime without translating params by hand::
+
+    from raft_tpu.compat.pylibraft.neighbors import ivf_flat, serving
+    index = ivf_flat.build(ivf_flat.IndexParams(n_lists=64), dataset)
+    with serving.Server(ivf_flat.SearchParams(n_probes=8), index, k=10) as s:
+        dist, ids = s.search(queries)
+"""
+
+from __future__ import annotations
+
+__all__ = ["Server"]
+
+
+def _native_search_params(search_params, index):
+    """Translate a compat SearchParams (any family) to the native params
+    struct ``raft_tpu.serve`` expects; native params pass through."""
+    if search_params is None:
+        return None
+    from . import cagra as _cagra
+    from . import ivf_flat as _ivf_flat
+    from . import ivf_pq as _ivf_pq
+
+    if isinstance(search_params, _ivf_flat.SearchParams):
+        from raft_tpu.neighbors.ivf_flat import IvfFlatSearchParams
+
+        return IvfFlatSearchParams(n_probes=int(search_params.n_probes))
+    if isinstance(search_params, _ivf_pq.SearchParams):
+        from raft_tpu.neighbors.ivf_pq import IvfPqSearchParams
+
+        mode = "lut" if search_params.lut_dtype != "float32" else "auto"
+        return IvfPqSearchParams(n_probes=int(search_params.n_probes),
+                                 mode=mode)
+    if isinstance(search_params, _cagra.SearchParams):
+        from raft_tpu.neighbors.cagra import CagraSearchParams
+
+        return CagraSearchParams(
+            itopk_size=int(search_params.itopk_size),
+            search_width=max(1, int(search_params.search_width)),
+            max_iterations=int(search_params.max_iterations),
+            n_seeds=32 * max(1, int(search_params.num_random_samplings)))
+    return search_params  # native params (or brute-force) pass through
+
+
+class Server:
+    """``Server(SearchParams, index, k)`` — pylibraft-ordered wrapper
+    around :class:`raft_tpu.serve.SearchServer`.
+
+    Accepts every compat index (they *are* the native index objects) and
+    compat or native SearchParams; ``config`` takes a
+    :class:`raft_tpu.serve.ServerConfig` for the serving knobs.  Context
+    manager: enter starts (and warms) the dispatch thread, exit drains
+    and stops it.
+    """
+
+    def __init__(self, search_params, index, k, *, config=None,
+                 handle=None) -> None:
+        from raft_tpu.serve import SearchServer
+
+        self._server = SearchServer(
+            index, k=int(k),
+            params=_native_search_params(search_params, index),
+            config=config)
+
+    def __enter__(self) -> "Server":
+        self._server.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._server.stop()
+
+    def search(self, queries, k=None, deadline_ms=None):
+        """Synchronous serve: ``(distances, indices)`` numpy arrays."""
+        return self._server.search(queries, k, deadline_ms)
+
+    def submit(self, queries, k=None, deadline_ms=None):
+        """Async serve: a ``concurrent.futures.Future``."""
+        return self._server.submit(queries, k, deadline_ms)
+
+    def metrics(self) -> dict:
+        return self._server.metrics_snapshot()
+
+    @property
+    def native(self):
+        """The underlying :class:`raft_tpu.serve.SearchServer`."""
+        return self._server

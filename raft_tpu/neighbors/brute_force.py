@@ -33,7 +33,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..core.array import wrap_array
 from ..core.errors import expects
 
-__all__ = ["knn", "knn_sharded", "tile_knn_merge"]
+__all__ = ["knn", "knn_sharded", "searcher", "tile_knn_merge"]
 
 _NEG_INF = jnp.float32(-jnp.inf)
 
@@ -379,6 +379,35 @@ def knn(
     if keep is not None:
         ids = sentinel_filtered_ids(vals, ids)
     return vals, ids
+
+
+def searcher(database, k: int, *, metric: str = "sqeuclidean",
+             mode: str = "exact", tile: int = 8192, cand: int = 64,
+             cut: str = "exact", refine_precision: str = "highest"):
+    """Uniform serving entry point (``raft_tpu.serve`` contract): returns
+    ``(fn, operands)`` where ``fn(queries, *operands)`` produces the same
+    ``(distances, indices)`` as :func:`knn` for these arguments — every
+    static knob pre-bound so ``queries`` is the only shape-varying input,
+    and ``fn`` AOT-compiles via
+    ``jax.jit(fn).lower(q_spec, *operands).compile()``.  Index state rides
+    as operands (not closure constants) so one executable per query bucket
+    never embeds a copy of the database."""
+    y = wrap_array(database, ndim=2, name="database")
+    expects(k >= 1, "k must be >= 1")
+    expects(k <= y.shape[0], f"k={k} exceeds database size {y.shape[0]}")
+    expects(mode in ("exact", "fast"), f"unknown mode {mode!r}")
+    expects(cut in ("exact", "approx"), f"unknown cut {cut!r}")
+    expects(refine_precision in ("highest", "high"),
+            f"unknown refine_precision {refine_precision!r}")
+    if mode == "fast":
+        c = int(max(cand, k))
+        fn = lambda q, yy: _fast_knn_impl(q, yy, int(k), metric, c,
+                                          1024, 1024, None, cut,
+                                          refine_precision)
+    else:
+        t = int(min(tile, max(y.shape[0], 1)))
+        fn = lambda q, yy: _knn_impl(q, yy, int(k), metric, t, None)
+    return fn, (y,)
 
 
 @functools.lru_cache(maxsize=64)

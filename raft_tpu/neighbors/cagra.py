@@ -52,6 +52,7 @@ __all__ = [
     "optimize_graph",
     "refine_knn_graph",
     "search",
+    "searcher",
     "search_sharded",
     "ShardedCagraIndex",
 ]
@@ -736,3 +737,29 @@ def search(index: CagraIndex, queries, k: int,
     if keep is not None:
         di = sentinel_filtered_ids(dv, di)
     return dv, di
+
+
+def searcher(index: CagraIndex, k: int,
+             params: Optional[CagraSearchParams] = None, *, seed: int = 0):
+    """Uniform serving entry point (``raft_tpu.serve`` contract): returns
+    ``(fn, operands)`` with ``fn(queries, *operands)`` equal to
+    :func:`search` at the same ``seed``.  The PRNG key rides as an operand
+    (the beam's random extra seeds are shared across queries, so padded
+    serving batches stay row-identical to a direct call); dataset/graph
+    ride as operands so bucket executables share them."""
+    p = params or CagraSearchParams()
+    expects(k >= 1, "k must be >= 1")
+    itopk = int(max(p.itopk_size, k))
+    width = int(p.search_width)
+    iters = int(p.max_iterations or max(1, (itopk + width - 1) // width))
+    n_seeds = int(min(p.n_seeds, index.size))
+    metric = index.metric
+    key = jax.random.PRNGKey(seed)
+
+    def fn(q, dataset, graph, routers, router_nodes, kk):
+        return _search_impl(dataset, graph, routers, router_nodes, q, kk,
+                            int(k), itopk, width, iters, n_seeds, metric,
+                            None)
+
+    return fn, (index.dataset, index.graph, index.router_centroids,
+                index.router_nodes, key)

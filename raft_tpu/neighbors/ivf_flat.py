@@ -46,6 +46,7 @@ __all__ = [
     "build",
     "build_chunked",
     "search",
+    "searcher",
     "extend",
     "build_sharded",
     "search_sharded",
@@ -325,6 +326,29 @@ def search(index: IvfFlatIndex, queries, k: int,
     if keep is not None:  # sub-k survivors: sentinel tail, not real ids
         di = sentinel_filtered_ids(dv, di)
     return dv, di
+
+
+def searcher(index: IvfFlatIndex, k: int,
+             params: Optional[IvfFlatSearchParams] = None):
+    """Uniform serving entry point (``raft_tpu.serve`` contract): returns
+    ``(fn, operands)`` with ``fn(queries, *operands)`` equal to
+    :func:`search` for query batches up to ``params.query_chunk`` rows
+    (above that :func:`search` chunks; serving buckets stay well below).
+    ``fn`` AOT-compiles via
+    ``jax.jit(fn).lower(q_spec, *operands).compile()``; the index slabs
+    ride as operands so bucket executables share them instead of baking
+    per-bucket constants."""
+    p = params or IvfFlatSearchParams()
+    expects(k >= 1, "k must be >= 1")
+    n_probes = int(min(p.n_probes, index.n_lists))
+    metric = index.metric
+
+    def fn(q, centroids, data, ids, counts, norms):
+        return _search_impl(centroids, data, ids, counts, norms, q,
+                            int(k), n_probes, metric, None)
+
+    return fn, (index.centroids, index.data, index.ids, index.counts,
+                index.norms)
 
 
 # ---------------------------------------------------------------------------

@@ -52,6 +52,7 @@ __all__ = [
     "build_chunked",
     "extend",
     "search",
+    "searcher",
     "build_sharded",
     "search_sharded",
 ]
@@ -598,6 +599,43 @@ def search(index: IvfPqIndex, queries, k: int,
     if keep is not None:  # sub-k survivors: sentinel tail, not real ids
         di = sentinel_filtered_ids(dv, di)
     return dv, di
+
+
+def searcher(index: IvfPqIndex, k: int,
+             params: Optional[IvfPqSearchParams] = None):
+    """Uniform serving entry point (``raft_tpu.serve`` contract): returns
+    ``(fn, operands)`` with ``fn(queries, *operands)`` equal to
+    :func:`search` for query batches up to ``params.query_chunk`` rows.
+    Mode resolution matches :func:`search` (``auto`` → recon tier when the
+    slab is materialized, LUT otherwise); index state rides as operands so
+    per-bucket executables never embed slab copies."""
+    p = params or IvfPqSearchParams()
+    expects(k >= 1, "k must be >= 1")
+    expects(p.mode in ("auto", "recon", "lut"), f"unknown mode {p.mode!r}")
+    n_probes = int(min(p.n_probes, index.n_lists))
+    metric = index.metric
+    mode = p.mode
+    if mode == "auto":
+        mode = "recon" if index.recon is not None else "lut"
+    if mode == "recon":
+        expects(index.recon is not None,
+                "mode='recon' needs the reconstruction slab — call "
+                "index.with_recon() (e.g. after load_index)")
+
+        def fn(q, centroids, recon, recon_norms, ids):
+            return _search_recon_impl(centroids, recon, recon_norms, ids,
+                                      q, int(k), n_probes, metric, None)
+
+        return fn, (index.centroids, index.recon, index.recon_norms,
+                    index.ids)
+
+    def fn(q, centroids, codebooks, codes, code_norms, ids, counts):
+        return _search_lut_impl(centroids, codebooks, codes, code_norms,
+                                ids, counts, q, int(k), n_probes, metric,
+                                None)
+
+    return fn, (index.centroids, index.codebooks, index.codes,
+                index.code_norms, index.ids, index.counts)
 
 
 # ---------------------------------------------------------------------------

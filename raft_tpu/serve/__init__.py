@@ -1,0 +1,66 @@
+"""raft_tpu.serve — online query-serving runtime for the ANN index family.
+
+Converts the library's one-shot ``search(queries, k)`` calls into the
+inference-stack shape the north star demands (ROADMAP: "serves heavy
+traffic from millions of users"): a :class:`SearchServer` wraps any built
+index and owns
+
+* a **micro-batcher** that coalesces concurrent ``submit()`` calls into
+  padded batches drawn from a configurable **shape-bucket ladder**
+  (:mod:`.bucketing`), so ragged traffic always dispatches one of a fixed
+  set of shapes — TPU-KNN-style MXU batches, zero recompilation;
+* a **shape-bucketed AOT executable cache** (:mod:`.cache`) keyed by
+  (index family, bucket, k, dtype, degrade level) using the
+  ``jax.jit(...).lower().compile()`` discipline of
+  ``tests/test_export_aot.py``, warm-started over the whole ladder at
+  server start;
+* **deadline-aware admission control** (:mod:`.admission`): a bounded
+  queue, per-request deadlines with timeout rejection, and graceful
+  degradation — under queue pressure the effort knobs (``n_probes`` /
+  ``itopk`` / shortlist width) shrink so overload degrades recall, not
+  latency;
+* **serving metrics** (:mod:`.metrics`): queue depth, batch-fill ratio,
+  p50/p95/p99 latency, timeout/reject counts, compile-cache hits —
+  JSON-dumpable for the bench harness (``bench/serve.py``) and annotated
+  into profiler timelines via :mod:`raft_tpu.core.tracing`.
+
+Served results are bit-identical to a direct index ``search()``: every
+index family exposes a uniform ``searcher()`` entry point returning a
+``(fn, operands)`` pair whose padded-bucket execution is row-independent,
+so padding never perturbs real rows.
+
+>>> import numpy as np
+>>> from raft_tpu.serve import SearchServer, ServerConfig
+>>> db = np.random.default_rng(0).standard_normal((256, 16)).astype(np.float32)
+>>> srv = SearchServer(db, k=3, config=ServerConfig(ladder=(4, 16)))
+>>> _ = srv.start()   # warms the ladder, starts the dispatch thread
+>>> d, i = srv.search(db[:2])
+>>> bool((np.asarray(i)[:, 0] == np.arange(2)).all())
+True
+>>> srv.stop()
+"""
+
+from .admission import (AdmissionController, AdmissionPolicy,
+                        DeadlineExceeded, QueueFull, ServeError)
+from .bucketing import DEFAULT_LADDER, bucket_for, normalize_ladder
+from .cache import ExecutableCache
+from .metrics import ServingMetrics
+from .searchers import family_of, make_searcher
+from .server import SearchServer, ServerConfig
+
+__all__ = [
+    "SearchServer",
+    "ServerConfig",
+    "ExecutableCache",
+    "ServingMetrics",
+    "AdmissionPolicy",
+    "AdmissionController",
+    "ServeError",
+    "QueueFull",
+    "DeadlineExceeded",
+    "DEFAULT_LADDER",
+    "bucket_for",
+    "normalize_ladder",
+    "family_of",
+    "make_searcher",
+]

@@ -1,0 +1,88 @@
+"""Admission control — bounded queue, per-request deadlines, graceful
+degradation.
+
+Overload policy (FusionANNS-style separation of admission from
+accelerator-side search): a full queue rejects at ``submit()``
+(:class:`QueueFull`, the client's backpressure signal); a request whose
+deadline passes while still queued is rejected at dequeue
+(:class:`DeadlineExceeded`) instead of wasting a dispatch on an answer
+nobody is waiting for; and sustained queue pressure activates
+*degradation levels* that shrink the search-effort knobs
+(``n_probes`` / ``itopk`` / shortlist width, :mod:`.searchers`) so
+overload costs recall instead of latency.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+from ..core.errors import RaftError, expects
+
+__all__ = ["ServeError", "QueueFull", "DeadlineExceeded",
+           "AdmissionPolicy", "AdmissionController"]
+
+
+class ServeError(RaftError):
+    """Base class for serving-runtime errors."""
+
+
+class QueueFull(ServeError):
+    """Request rejected at submit: the bounded queue is at capacity."""
+
+
+class DeadlineExceeded(ServeError):
+    """Request rejected: its deadline passed before dispatch."""
+
+
+@dataclasses.dataclass(frozen=True)
+class AdmissionPolicy:
+    """Knobs for the bounded queue and the pressure→degradation map.
+
+    ``degrade_queue_fractions`` are occupancy thresholds (of
+    ``max_queue``): depth >= fraction_i activates degradation level i+1.
+    The default (0.5, 0.8) gives three levels: full quality below half
+    occupancy, level 1 above it, level 2 near saturation.
+    """
+
+    max_queue: int = 1024
+    default_deadline_ms: float = 1000.0
+    degrade_queue_fractions: Tuple[float, ...] = (0.5, 0.8)
+
+    def __post_init__(self):
+        expects(self.max_queue >= 1, "max_queue must be >= 1")
+        expects(self.default_deadline_ms > 0,
+                "default_deadline_ms must be > 0")
+        expects(all(0.0 < f <= 1.0 for f in self.degrade_queue_fractions),
+                "degrade_queue_fractions must lie in (0, 1]")
+        expects(tuple(sorted(self.degrade_queue_fractions))
+                == tuple(self.degrade_queue_fractions),
+                "degrade_queue_fractions must be sorted ascending")
+
+
+class AdmissionController:
+    """Pure decision logic (no clock, no locks — the server owns both)."""
+
+    def __init__(self, policy: AdmissionPolicy) -> None:
+        self.policy = policy
+
+    def admit(self, depth: int) -> bool:
+        """May a new request enter a queue currently at ``depth``?"""
+        return depth < self.policy.max_queue
+
+    def level(self, depth: int) -> int:
+        """Degradation level for the current queue depth (0 = full
+        quality)."""
+        lvl = 0
+        for frac in self.policy.degrade_queue_fractions:
+            if depth >= frac * self.policy.max_queue:
+                lvl += 1
+        return lvl
+
+    def deadline(self, now: float, deadline_ms=None) -> float:
+        """Absolute deadline (server-clock seconds) for a request
+        submitted at ``now``."""
+        ms = self.policy.default_deadline_ms if deadline_ms is None \
+            else float(deadline_ms)
+        expects(ms > 0, "deadline_ms must be > 0")
+        return now + ms / 1e3

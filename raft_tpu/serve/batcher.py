@@ -1,0 +1,115 @@
+"""Micro-batcher — coalesce pending requests into one bucket-shaped
+dispatch.
+
+Pure planning logic over the server's FIFO queue (no locks, no clock):
+take the head request, then extend with successors sharing its
+(k, dtype) cache coordinates while the running row total still fits the
+largest ladder bucket.  FIFO order is preserved — a same-shape request
+never overtakes an older incompatible one (which would starve it under
+sustained mixed traffic).
+
+Oversized requests are split at submit into ≤ max-bucket parts sharing
+one :class:`SplitSink`, so a 10k-row bulk query streams through the
+ladder's largest executable at full fill instead of demanding its own
+shape.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .bucketing import bucket_for
+
+__all__ = ["Request", "SplitSink", "plan_batch"]
+
+
+class SplitSink:
+    """Aggregates the parts of a split request back into one future.
+
+    Parts complete in submission order (FIFO queue, single dispatch
+    thread), but the sink tolerates any order; the first failing part
+    fails the whole request."""
+
+    def __init__(self, future, n_parts: int) -> None:
+        self.future = future
+        self._lock = threading.Lock()
+        self._parts: List = [None] * n_parts
+        self._missing = n_parts
+
+    def deliver(self, part: int, dist: np.ndarray, idx: np.ndarray) -> None:
+        with self._lock:
+            if self.future.done():
+                return
+            self._parts[part] = (dist, idx)
+            self._missing -= 1
+            done = self._missing == 0
+        if done:
+            d = np.concatenate([p[0] for p in self._parts], axis=0)
+            i = np.concatenate([p[1] for p in self._parts], axis=0)
+            self.future.set_result((d, i))
+
+    def fail(self, exc: BaseException) -> None:
+        with self._lock:
+            if self.future.done():
+                return
+            self.future.set_exception(exc)
+
+
+@dataclasses.dataclass
+class Request:
+    """One queue entry (a whole request, or one part of a split one)."""
+
+    queries: np.ndarray          # (rows, d) host block
+    k: int
+    deadline: float              # absolute server-clock seconds
+    t_submit: float
+    future: object = None        # set for unsplit requests
+    sink: Optional[SplitSink] = None   # set for split parts
+    part: int = 0
+
+    @property
+    def rows(self) -> int:
+        return int(self.queries.shape[0])
+
+    @property
+    def dtype_key(self) -> str:
+        return str(self.queries.dtype)
+
+    def resolve(self, dist: np.ndarray, idx: np.ndarray) -> None:
+        if self.sink is not None:
+            self.sink.deliver(self.part, dist, idx)
+        elif not self.future.done():
+            self.future.set_result((dist, idx))
+
+    def reject(self, exc: BaseException) -> None:
+        if self.sink is not None:
+            self.sink.fail(exc)
+        elif not self.future.done():
+            self.future.set_exception(exc)
+
+
+def plan_batch(pending: Sequence[Request],
+               ladder: Sequence[int]) -> Tuple[List[Request], int]:
+    """Pick the next dispatch from the FIFO queue.
+
+    Returns ``(requests, bucket)``; callers pop exactly those entries.
+    Greedy FIFO-prefix fill: head first, then later entries with the
+    head's (k, dtype) while total rows still fit the largest bucket —
+    skipped (incompatible) entries keep their queue position for the
+    next plan."""
+    head = pending[0]
+    take = [head]
+    total = head.rows
+    max_bucket = ladder[-1]
+    for req in list(pending)[1:]:
+        if req.k != head.k or req.dtype_key != head.dtype_key:
+            continue
+        if total + req.rows > max_bucket:
+            break
+        take.append(req)
+        total += req.rows
+    return take, bucket_for(total, ladder)

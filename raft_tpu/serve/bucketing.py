@@ -1,0 +1,70 @@
+"""Shape-bucket ladder — the fixed set of query-batch shapes a server
+ever dispatches.
+
+TPU search programs jit-specialize on the query-batch shape; ragged
+online traffic would recompile per distinct size.  The ladder quantizes
+every batch up to the smallest bucket that fits (padding with zero rows —
+all search impls are row-independent, so pads never perturb real rows),
+bounding the executable population at ``len(ladder)`` per
+(family, k, dtype, level) and keeping every dispatch MXU-shaped.
+
+Sizing guidance lives in ``docs/serving_guide.md``: geometric ladders
+(e.g. 1/8/64/512) cap padding waste at ~8× worst case while covering
+single-query point lookups and bulk scoring with four executables.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.errors import expects
+
+__all__ = ["DEFAULT_LADDER", "normalize_ladder", "bucket_for",
+           "split_rows", "pad_rows"]
+
+DEFAULT_LADDER: Tuple[int, ...] = (1, 8, 64, 512)
+
+
+def normalize_ladder(ladder: Sequence[int]) -> Tuple[int, ...]:
+    """Validate + canonicalize: sorted, deduplicated, all >= 1."""
+    expects(len(tuple(ladder)) > 0, "bucket ladder must not be empty")
+    lad = tuple(sorted({int(b) for b in ladder}))
+    expects(lad[0] >= 1, f"bucket sizes must be >= 1, got {lad}")
+    return lad
+
+
+def bucket_for(n: int, ladder: Sequence[int]) -> Optional[int]:
+    """Smallest bucket holding ``n`` rows, or None when ``n`` exceeds the
+    ladder (the caller splits via :func:`split_rows`)."""
+    for b in ladder:
+        if n <= b:
+            return int(b)
+    return None
+
+
+def split_rows(n: int, max_bucket: int):
+    """Greedy split of an oversized request into ``<= max_bucket``-row
+    parts (all but the last full-sized, so they batch alone at perfect
+    fill)."""
+    expects(n >= 1, "need at least one row")
+    out = []
+    while n > 0:
+        take = min(n, int(max_bucket))
+        out.append(take)
+        n -= take
+    return out
+
+
+def pad_rows(rows: np.ndarray, bucket: int) -> np.ndarray:
+    """Zero-pad a host (n, d) block to ``(bucket, d)`` (no-op when full).
+    Zero rows are safe: every search impl is per-row independent, and the
+    server slices the first n result rows back out."""
+    n, d = rows.shape
+    expects(n <= bucket, f"{n} rows exceed bucket {bucket}")
+    if n == bucket:
+        return rows
+    out = np.zeros((bucket, d), dtype=rows.dtype)
+    out[:n] = rows
+    return out

@@ -1,0 +1,76 @@
+"""AOT executable cache — one compiled program per (family, bucket, k,
+dtype, degrade level).
+
+Uses the ``jax.jit(fn).lower(spec, *operands).compile()`` discipline of
+``tests/test_export_aot.py``: the searcher ``fn`` takes the index state
+as *operands* (never closure constants), so every bucket executable
+shares the same on-device slabs instead of baking per-bucket copies.
+
+Counters (hits / misses / compiles) are the observability contract the
+serve guard tests assert on: a mixed-shape workload must never compile
+more than ``len(ladder)`` executables per (family, k, dtype, level).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Tuple
+
+import jax
+
+from ..core import tracing
+
+__all__ = ["ExecutableCache"]
+
+
+class ExecutableCache:
+    """Thread-safe compile-once cache of AOT-lowered search executables.
+
+    ``get(key, builder)`` returns ``(compiled, operands)``; ``builder`` is
+    only invoked on a miss and must return ``(fn, operands, q_spec)``
+    where ``fn(queries, *operands)`` is jit-traceable and ``q_spec`` is a
+    ``jax.ShapeDtypeStruct`` for the padded query bucket.  Compilation
+    happens under the cache lock — the single-writer discipline that makes
+    the compile counter an exact recompilation census (the property the
+    serve guard test asserts).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._entries: dict = {}
+        self.hits = 0
+        self.misses = 0
+        self.compiles = 0
+        self.compile_s = 0.0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def get(self, key, builder: Callable[[], Tuple]):
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self.hits += 1
+                return entry
+            self.misses += 1
+            fn, operands, q_spec = builder()
+            t0 = time.perf_counter()
+            with tracing.range("serve.compile(%s)", key):
+                compiled = jax.jit(fn).lower(q_spec, *operands).compile()
+            self.compile_s += time.perf_counter() - t0
+            self.compiles += 1
+            entry = (compiled, operands)
+            self._entries[key] = entry
+            return entry
+
+    def contains(self, key) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries), "hits": self.hits,
+                    "misses": self.misses, "compiles": self.compiles,
+                    "compile_s": round(self.compile_s, 3)}

@@ -1,0 +1,103 @@
+"""Serving metrics — counters, latency percentiles, JSON export.
+
+One :class:`ServingMetrics` per server: submit/reject/timeout counters,
+batch shape accounting (fill ratio = real rows / padded rows, the
+padding-waste signal that tunes the bucket ladder), a bounded latency
+reservoir for p50/p95/p99, and per-level degradation dispatch counts.
+``snapshot()`` is the JSON schema documented in
+``docs/serving_guide.md`` and consumed by ``bench/serve.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+from collections import deque
+
+__all__ = ["ServingMetrics", "percentile"]
+
+
+def percentile(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile over an ascending list (0 < q <= 100)."""
+    if not sorted_vals:
+        return 0.0
+    rank = max(0, min(len(sorted_vals) - 1,
+                      math.ceil(q / 100.0 * len(sorted_vals)) - 1))
+    return float(sorted_vals[rank])
+
+
+class ServingMetrics:
+    """Thread-safe counters + bounded latency reservoir."""
+
+    def __init__(self, latency_window: int = 4096) -> None:
+        self._lock = threading.Lock()
+        self._lat_ms = deque(maxlen=int(latency_window))
+        self.submitted = 0           # requests accepted into the queue
+        self.completed = 0           # requests answered
+        self.rejected_queue_full = 0
+        self.rejected_deadline = 0   # expired while queued, never dispatched
+        self.late_completions = 0    # answered, but past their deadline
+        self.batches = 0
+        self.real_rows = 0           # query rows carried by requests
+        self.padded_rows = 0         # bucket rows dispatched (>= real_rows)
+        self.degrade_dispatches: dict = {}  # level -> batch count
+
+    def count(self, field: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+
+    def observe_batch(self, bucket: int, rows: int, level: int) -> None:
+        with self._lock:
+            self.batches += 1
+            self.real_rows += int(rows)
+            self.padded_rows += int(bucket)
+            self.degrade_dispatches[level] = \
+                self.degrade_dispatches.get(level, 0) + 1
+
+    def observe_latency(self, ms: float, late: bool = False) -> None:
+        with self._lock:
+            self.completed += 1
+            self._lat_ms.append(float(ms))
+            if late:
+                self.late_completions += 1
+
+    def snapshot(self) -> dict:
+        """Point-in-time metrics dict (the serving-guide JSON schema)."""
+        with self._lock:
+            lat = sorted(self._lat_ms)
+            fill = (self.real_rows / self.padded_rows
+                    if self.padded_rows else 0.0)
+            return {
+                "submitted": self.submitted,
+                "completed": self.completed,
+                "rejected_queue_full": self.rejected_queue_full,
+                "rejected_deadline": self.rejected_deadline,
+                "late_completions": self.late_completions,
+                "batches": self.batches,
+                "real_rows": self.real_rows,
+                "padded_rows": self.padded_rows,
+                "batch_fill_ratio": round(fill, 4),
+                "degrade_dispatches": {str(k): v for k, v in
+                                       sorted(self.degrade_dispatches.items())},
+                "latency_ms": {
+                    "count": len(lat),
+                    "p50": round(percentile(lat, 50), 3),
+                    "p95": round(percentile(lat, 95), 3),
+                    "p99": round(percentile(lat, 99), 3),
+                    "max": round(lat[-1], 3) if lat else 0.0,
+                },
+            }
+
+    def to_json(self, path=None, extra=None) -> str:
+        """Serialize ``snapshot()`` (+ optional extra keys, e.g. cache
+        counters and queue depth from the server) to JSON; write to
+        ``path`` when given."""
+        snap = self.snapshot()
+        if extra:
+            snap.update(extra)
+        text = json.dumps(snap, indent=2, sort_keys=True)
+        if path:
+            with open(path, "w") as f:
+                f.write(text + "\n")
+        return text

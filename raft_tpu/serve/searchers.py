@@ -1,0 +1,134 @@
+"""Family dispatch — map any built index to its uniform ``searcher()``
+entry point, with degradation-level effort scaling.
+
+Every index family exposes ``searcher(index, k, params) -> (fn,
+operands)`` where ``fn(queries, *operands)`` matches a direct
+``search()`` call bit-for-bit and AOT-compiles with ``queries`` as the
+only shape-varying input.  This module owns (a) the type→family mapping
+and (b) the per-family *effort knob* a degradation level shrinks:
+
+* ``ivf_flat`` / ``ivf_pq`` — ``n_probes`` (fewer lists scanned),
+* ``cagra`` — ``itopk_size`` (narrower beam; iterations follow),
+* ``brute_force`` fast mode — ``cand`` (shorter shortlist); exact mode
+  has no quality knob and degrades to itself.
+
+Scaled knobs are floored so a degraded searcher still returns k valid
+results (``n_probes >= 1``, ``itopk >= k``, ``cand >= k``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+
+from ..core.errors import expects
+
+__all__ = ["BruteForceSearchParams", "family_of", "make_searcher",
+           "index_dim", "index_size", "query_dtype_of"]
+
+
+@dataclasses.dataclass(frozen=True)
+class BruteForceSearchParams:
+    """Search-time knobs for serving a raw (n, d) database with
+    :func:`raft_tpu.neighbors.brute_force.knn` semantics (the family has
+    no index object, so the params struct lives here)."""
+
+    metric: str = "sqeuclidean"
+    mode: str = "exact"          # exact | fast
+    tile: int = 8192
+    cand: int = 64               # fast-mode shortlist width
+    cut: str = "exact"
+    refine_precision: str = "highest"
+
+
+def family_of(index) -> str:
+    """Index family name for cache keys / metrics labels."""
+    from ..neighbors.cagra import CagraIndex
+    from ..neighbors.ivf_flat import IvfFlatIndex
+    from ..neighbors.ivf_pq import IvfPqIndex
+
+    if isinstance(index, IvfFlatIndex):
+        return "ivf_flat"
+    if isinstance(index, IvfPqIndex):
+        return "ivf_pq"
+    if isinstance(index, CagraIndex):
+        return "cagra"
+    if isinstance(index, (jax.Array, np.ndarray)) and index.ndim == 2:
+        return "brute_force"
+    raise TypeError(f"no serving searcher for {type(index).__name__}; "
+                    "expected IvfFlatIndex/IvfPqIndex/CagraIndex or a 2-D "
+                    "database array")
+
+
+def index_dim(index) -> int:
+    return int(index.shape[1]) if family_of(index) == "brute_force" \
+        else int(index.dim)
+
+
+def index_size(index) -> int:
+    return int(index.shape[0]) if family_of(index) == "brute_force" \
+        else int(index.size)
+
+
+def query_dtype_of(index):
+    """The dtype warm-up should precompile for — the dtype the stored
+    vectors expect queries in (requests with another dtype compile their
+    own bucket set on first use)."""
+    fam = family_of(index)
+    if fam == "brute_force":
+        return jax.numpy.asarray(index[:1]).dtype if isinstance(
+            index, np.ndarray) else index.dtype
+    if fam == "cagra":
+        return index.dataset.dtype
+    return index.centroids.dtype
+
+
+def _scaled(value: int, scale: float, floor: int) -> int:
+    return max(int(floor), int(round(value * float(scale))))
+
+
+def make_searcher(index, k: int, params=None, *, effort_scale: float = 1.0,
+                  seed: int = 0):
+    """Build the ``(fn, operands)`` searcher for ``index`` at one
+    degradation point.  ``effort_scale`` in (0, 1] multiplies the
+    family's effort knob; 1.0 reproduces direct ``search()`` exactly
+    (the serve bit-identity contract)."""
+    expects(0.0 < effort_scale <= 1.0,
+            f"effort_scale must be in (0, 1], got {effort_scale}")
+    fam = family_of(index)
+    if fam == "brute_force":
+        from ..neighbors import brute_force
+
+        p = params or BruteForceSearchParams()
+        cand = _scaled(p.cand, effort_scale, k) if p.mode == "fast" \
+            else p.cand
+        return brute_force.searcher(
+            index, k, metric=p.metric, mode=p.mode, tile=p.tile, cand=cand,
+            cut=p.cut, refine_precision=p.refine_precision)
+    if fam == "ivf_flat":
+        from ..neighbors import ivf_flat
+
+        p = params or ivf_flat.IvfFlatSearchParams()
+        if effort_scale < 1.0:
+            p = dataclasses.replace(
+                p, n_probes=_scaled(min(p.n_probes, index.n_lists),
+                                    effort_scale, 1))
+        return ivf_flat.searcher(index, k, p)
+    if fam == "ivf_pq":
+        from ..neighbors import ivf_pq
+
+        p = params or ivf_pq.IvfPqSearchParams()
+        if effort_scale < 1.0:
+            p = dataclasses.replace(
+                p, n_probes=_scaled(min(p.n_probes, index.n_lists),
+                                    effort_scale, 1))
+        return ivf_pq.searcher(index, k, p)
+    from ..neighbors import cagra
+
+    p = params or cagra.CagraSearchParams()
+    if effort_scale < 1.0:
+        p = dataclasses.replace(
+            p, itopk_size=_scaled(max(p.itopk_size, k), effort_scale, k))
+    return cagra.searcher(index, k, p, seed=seed)

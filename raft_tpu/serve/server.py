@@ -1,0 +1,346 @@
+"""SearchServer — the online serving front-end over any built index.
+
+Composition (one instance each): a FIFO request queue guarded by a
+condition variable, the :mod:`.batcher` plan, the :mod:`.cache` of
+AOT bucket executables, the :mod:`.admission` controller, and
+:mod:`.metrics`.  A single dispatch thread owns the accelerator —
+requests enter via ``submit()`` from any number of client threads and
+resolve through ``concurrent.futures.Future``.
+
+Determinism hooks for tests: construct with a fake ``clock``, skip
+``start()``, and drive dispatches synchronously with ``step()``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import tracing
+from ..core.errors import expects
+from ..core.logging import default_logger
+from .admission import (AdmissionController, AdmissionPolicy,
+                        DeadlineExceeded, QueueFull, ServeError)
+from .batcher import Request, SplitSink, plan_batch
+from .bucketing import DEFAULT_LADDER, normalize_ladder, pad_rows, split_rows
+from .cache import ExecutableCache
+from .metrics import ServingMetrics
+from .searchers import (family_of, index_dim, index_size, make_searcher,
+                        query_dtype_of)
+
+__all__ = ["ServerConfig", "SearchServer"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ServerConfig:
+    """Serving knobs (see ``docs/serving_guide.md`` for sizing).
+
+    ``ladder``: the shape buckets; ``max_wait_ms``: how long the batcher
+    holds a non-full batch open for more arrivals; ``warm_levels``: how
+    many degradation levels ``start()`` precompiles (level 0 is the
+    bit-identical full-quality tier; deeper levels compile on first
+    pressure unless warmed here).
+    """
+
+    ladder: Tuple[int, ...] = DEFAULT_LADDER
+    max_wait_ms: float = 2.0
+    max_queue: int = 1024
+    default_deadline_ms: float = 1000.0
+    degrade_queue_fractions: Tuple[float, ...] = (0.5, 0.8)
+    degrade_effort_scales: Tuple[float, ...] = (1.0, 0.5, 0.25)
+    warm_levels: int = 1
+    latency_window: int = 4096
+
+    def __post_init__(self):
+        expects(len(self.degrade_effort_scales)
+                == len(self.degrade_queue_fractions) + 1,
+                "need one effort scale per degradation level (fractions"
+                " define levels 1.., scales include level 0)")
+        expects(self.degrade_effort_scales[0] == 1.0,
+                "level 0 must be full quality (scale 1.0) — the serve"
+                " bit-identity contract")
+        expects(1 <= self.warm_levels <= len(self.degrade_effort_scales),
+                "warm_levels out of range")
+        expects(self.max_wait_ms >= 0, "max_wait_ms must be >= 0")
+
+
+class SearchServer:
+    """Micro-batching, deadline-aware serving wrapper around one index.
+
+    ``index`` is any built index (IvfFlatIndex / IvfPqIndex / CagraIndex)
+    or a raw (n, d) database array (brute force).  ``params`` is that
+    family's SearchParams (``serve.searchers.BruteForceSearchParams`` for
+    raw arrays).  Results are bit-identical to the family's direct
+    ``search()`` at degradation level 0.
+
+    ``clock`` (monotonic seconds) is injectable for deterministic tests;
+    the dispatch thread's *waits* always use real time, so a fake clock
+    only makes sense with manual ``step()`` driving.
+    """
+
+    def __init__(self, index, k: int = 10, params=None, *,
+                 config: Optional[ServerConfig] = None,
+                 clock=time.monotonic, seed: int = 0, res=None) -> None:
+        self.index = index
+        self.family = family_of(index)
+        expects(1 <= k <= index_size(index),
+                f"k={k} out of range for index of {index_size(index)} rows")
+        self.k = int(k)
+        self.params = params
+        self.config = config or ServerConfig()
+        self.ladder = normalize_ladder(self.config.ladder)
+        self.clock = clock
+        self.seed = int(seed)
+        self._dim = index_dim(index)
+        self._qdtype = query_dtype_of(index)
+        self.cache = ExecutableCache()
+        self.metrics = ServingMetrics(self.config.latency_window)
+        self.admission = AdmissionController(AdmissionPolicy(
+            max_queue=self.config.max_queue,
+            default_deadline_ms=self.config.default_deadline_ms,
+            degrade_queue_fractions=self.config.degrade_queue_fractions))
+        self._log = default_logger() if res is None else None
+        self._cond = threading.Condition()
+        self._pending: list = []
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def warmup(self) -> int:
+        """Precompile the bucket ladder (× ``warm_levels`` degradation
+        tiers) for the default k and query dtype; returns the number of
+        executables compiled.  Idempotent — the cache makes reruns free."""
+        before = self.cache.compiles
+        with tracing.range("serve.warmup(%s)", self.family):
+            for level in range(self.config.warm_levels):
+                for bucket in self.ladder:
+                    self._compiled(bucket, self.k, self._qdtype, level)
+        n = self.cache.compiles - before
+        if self._log is not None and n:
+            self._log.info(
+                "serve warmup: %d executables (%s, ladder=%s, k=%d) in %.2fs",
+                n, self.family, self.ladder, self.k, self.cache.compile_s)
+        return n
+
+    def start(self, warmup: bool = True) -> "SearchServer":
+        """Warm the executable cache and start the dispatch thread."""
+        expects(self._thread is None, "server already started")
+        if warmup:
+            self.warmup()
+        self._running = True
+        self._thread = threading.Thread(target=self._worker,
+                                        name="raft-tpu-serve", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: Optional[float] = 30.0) -> None:
+        """Stop the dispatch thread; queued requests are drained first."""
+        if self._thread is None:
+            return
+        with self._cond:
+            self._running = False
+            self._cond.notify_all()
+        self._thread.join(timeout)
+        self._thread = None
+
+    def __enter__(self) -> "SearchServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- client surface -----------------------------------------------------
+
+    def submit(self, queries, k: Optional[int] = None,
+               deadline_ms: Optional[float] = None) -> Future:
+        """Enqueue a search; returns a Future resolving to
+        ``(distances, indices)`` numpy arrays of shape (rows, k).
+
+        Raises :class:`QueueFull` when the bounded queue is at capacity
+        (client backpressure); the Future raises
+        :class:`DeadlineExceeded` when the deadline passes before
+        dispatch.  Requests wider than the largest bucket are split and
+        reassembled transparently."""
+        q = np.asarray(queries)
+        if q.ndim == 1:
+            q = q[None, :]
+        expects(q.ndim == 2, "queries must be 1-D or 2-D")
+        expects(q.shape[0] >= 1, "empty query batch")
+        expects(q.shape[1] == self._dim,
+                f"query dim {q.shape[1]} != index dim {self._dim}")
+        kk = self.k if k is None else int(k)
+        expects(1 <= kk <= index_size(self.index),
+                f"k={kk} out of range for index of "
+                f"{index_size(self.index)} rows")
+        now = self.clock()
+        deadline = self.admission.deadline(now, deadline_ms)
+        future: Future = Future()
+        parts = split_rows(q.shape[0], self.ladder[-1])
+        with self._cond:
+            if not self.admission.admit(len(self._pending) + len(parts) - 1):
+                self.metrics.count("rejected_queue_full")
+                raise QueueFull(
+                    f"queue at capacity ({self.admission.policy.max_queue});"
+                    " retry with backoff or raise max_queue")
+            if len(parts) == 1:
+                self._pending.append(Request(q, kk, deadline, now,
+                                             future=future))
+            else:
+                sink = SplitSink(future, len(parts))
+                lo = 0
+                for i, rows in enumerate(parts):
+                    self._pending.append(Request(q[lo:lo + rows], kk,
+                                                 deadline, now, sink=sink,
+                                                 part=i))
+                    lo += rows
+            self.metrics.count("submitted")
+            self._cond.notify_all()
+        return future
+
+    def search(self, queries, k: Optional[int] = None,
+               deadline_ms: Optional[float] = None):
+        """Synchronous convenience: ``submit()`` + wait.  Without a
+        running dispatch thread this drives ``step()`` inline (the
+        deterministic single-threaded mode the unit tests use)."""
+        fut = self.submit(queries, k, deadline_ms)
+        if self._thread is None:
+            while not fut.done() and self.step():
+                pass
+        return fut.result(timeout=None if self._thread is None else
+                          self.admission.policy.default_deadline_ms / 1e3
+                          + 300.0)
+
+    # -- dispatch -----------------------------------------------------------
+
+    def step(self, now: Optional[float] = None) -> int:
+        """Process one batch synchronously; returns the number of queue
+        entries retired (0 = queue empty).  Expired entries are rejected
+        before planning, so a step may retire requests without touching
+        the accelerator."""
+        if now is None:
+            now = self.clock()
+        with self._cond:
+            expired = [r for r in self._pending if r.deadline < now]
+            if expired:
+                self._pending = [r for r in self._pending
+                                 if r.deadline >= now]
+            if not self._pending:
+                batch = None
+            else:
+                depth = len(self._pending)
+                batch, bucket = plan_batch(self._pending, self.ladder)
+                chosen = set(map(id, batch))
+                self._pending = [r for r in self._pending
+                                 if id(r) not in chosen]
+        for req in expired:
+            self.metrics.count("rejected_deadline")
+            req.reject(DeadlineExceeded(
+                f"deadline passed {1e3 * (now - req.deadline):.1f}ms before"
+                " dispatch (queue wait exceeded the budget)"))
+        if batch is None:
+            return len(expired)
+        level = min(self.admission.level(depth),
+                    len(self.config.degrade_effort_scales) - 1)
+        self._execute(batch, bucket, level)
+        return len(expired) + len(batch)
+
+    def _builder(self, bucket: int, k: int, dtype, level: int):
+        def build():
+            scale = self.config.degrade_effort_scales[level]
+            fn, operands = make_searcher(self.index, k, self.params,
+                                         effort_scale=scale, seed=self.seed)
+            spec = jax.ShapeDtypeStruct((bucket, self._dim), dtype)
+            return fn, operands, spec
+        return build
+
+    def _compiled(self, bucket: int, k: int, dtype, level: int):
+        key = (self.family, int(bucket), int(k), str(jnp.dtype(dtype)),
+               int(level))
+        return self.cache.get(key, self._builder(bucket, k, dtype, level))
+
+    def _execute(self, batch, bucket: int, level: int) -> None:
+        rows = sum(r.rows for r in batch)
+        qpad = pad_rows(np.concatenate([r.queries for r in batch], axis=0)
+                        if len(batch) > 1 else batch[0].queries, bucket)
+        try:
+            compiled, operands = self._compiled(bucket, batch[0].k,
+                                                qpad.dtype, level)
+            with tracing.range("serve.dispatch(%s,b=%d,k=%d,lvl=%d)",
+                               self.family, bucket, batch[0].k, level):
+                d, i = compiled(jnp.asarray(qpad), *operands)
+                d = np.asarray(d)   # host fetch = completion barrier
+                i = np.asarray(i)
+        except Exception as exc:  # noqa: BLE001 — fail the batch, not the server
+            for req in batch:
+                req.reject(ServeError(f"dispatch failed: {exc!r}"))
+            raise
+        done = self.clock()
+        self.metrics.observe_batch(bucket, rows, level)
+        lo = 0
+        for req in batch:
+            hi = lo + req.rows
+            req.resolve(d[lo:hi], i[lo:hi])
+            self.metrics.observe_latency(1e3 * (done - req.t_submit),
+                                         late=done > req.deadline)
+            lo = hi
+
+    def _worker(self) -> None:
+        max_rows = self.ladder[-1]
+        wait_s = self.config.max_wait_ms / 1e3
+        while True:
+            with self._cond:
+                while self._running and not self._pending:
+                    self._cond.wait(0.05)
+                if not self._running and not self._pending:
+                    return
+                # batching window: hold for more arrivals until the
+                # largest bucket fills or the window elapses (real time —
+                # see the clock note in the class docstring)
+                t0 = time.monotonic()
+                while (self._running
+                       and sum(r.rows for r in self._pending) < max_rows):
+                    rem = t0 + wait_s - time.monotonic()
+                    if rem <= 0:
+                        break
+                    self._cond.wait(rem)
+            while self.step():
+                pass
+
+    # -- observability ------------------------------------------------------
+
+    def metrics_snapshot(self) -> dict:
+        """Serving metrics + live gauges + compile-cache counters (the
+        ``docs/serving_guide.md`` schema)."""
+        with self._cond:
+            depth = len(self._pending)
+            qrows = sum(r.rows for r in self._pending)
+        snap = self.metrics.snapshot()
+        snap.update({
+            "queue_depth": depth,
+            "queue_rows": qrows,
+            "degrade_level": self.admission.level(depth),
+            "cache": self.cache.snapshot(),
+            "server": {"family": self.family, "k": self.k,
+                       "ladder": list(self.ladder),
+                       "index_rows": index_size(self.index)},
+        })
+        return snap
+
+    def dump_metrics(self, path=None) -> str:
+        """JSON-serialize :meth:`metrics_snapshot` (optionally to a
+        file) — the bench harness's ingestion format."""
+        import json
+
+        text = json.dumps(self.metrics_snapshot(), indent=2, sort_keys=True)
+        if path:
+            with open(path, "w") as f:
+                f.write(text + "\n")
+        return text

@@ -258,3 +258,23 @@ def test_neighbors_out_params_filled():
     d, i = brute_force.knn(x, x[:4], 3, iout, dout)
     assert i is iout and d is dout
     assert (iout[:, 0] == np.arange(4)).all()
+
+
+def test_neighbors_serving_adapter():
+    """serving.Server speaks the params-first convention over compat
+    indexes/params and serves bit-identical results."""
+    from raft_tpu.compat.pylibraft.neighbors import ivf_flat, serving
+    from raft_tpu.serve import ServerConfig
+
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((300, 12)).astype(np.float32)
+    sp = ivf_flat.SearchParams(n_probes=6)
+    idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=6), x, handle=object())
+    d0, i0 = ivf_flat.search(sp, idx, x[:5], 4)
+    with serving.Server(sp, idx, 4,
+                        config=ServerConfig(ladder=(2, 8))) as srv:
+        d, i = srv.search(x[:5])
+        snap = srv.metrics()
+    np.testing.assert_array_equal(np.asarray(i0), i)
+    np.testing.assert_array_equal(np.asarray(d0), d)
+    assert snap["completed"] == 1 and snap["cache"]["compiles"] == 2

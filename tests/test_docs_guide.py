@@ -1,23 +1,32 @@
-"""Executes every ```python block in docs/tuning_guide.md in one shared
-namespace — the guide's snippets are tested code, extending the doctest
+"""Executes every ```python block in the prose guides
+(docs/tuning_guide.md, docs/serving_guide.md) in one shared namespace per
+guide — the guides' snippets are tested code, extending the doctest
 discipline (SURVEY.md §4) to the prose docs."""
 
 import os
 import re
 
-GUIDE = os.path.join(os.path.dirname(__file__), "..", "docs", "tuning_guide.md")
+DOCS = os.path.join(os.path.dirname(__file__), "..", "docs")
 
 
-def test_tuning_guide_snippets_execute():
-    with open(GUIDE) as f:
+def _run_guide(name: str, min_blocks: int) -> None:
+    with open(os.path.join(DOCS, name)) as f:
         text = f.read()
     blocks = re.findall(r"```python\n(.*?)```", text, re.DOTALL)
-    assert len(blocks) >= 5, "guide lost its examples"
+    assert len(blocks) >= min_blocks, f"{name} lost its examples"
     ns: dict = {}
     for i, block in enumerate(blocks):
         try:
-            exec(compile(block, f"tuning_guide.md[block {i}]", "exec"), ns)
+            exec(compile(block, f"{name}[block {i}]", "exec"), ns)
         except AssertionError as e:
             raise AssertionError(
-                f"tuning_guide.md block {i} failed its own assert: {e}"
+                f"{name} block {i} failed its own assert: {e}"
             ) from e
+
+
+def test_tuning_guide_snippets_execute():
+    _run_guide("tuning_guide.md", min_blocks=5)
+
+
+def test_serving_guide_snippets_execute():
+    _run_guide("serving_guide.md", min_blocks=2)

@@ -1,0 +1,428 @@
+"""raft_tpu.serve — serving-runtime tests.
+
+All tier-1 (CPU, fast).  The serving contract under test:
+
+* bucket/ladder planning and padding are pure and deterministic;
+* served results are **bit-identical** to direct ``search()`` for every
+  index family (exact array equality — padding must not perturb rows);
+* deadlines, queue bounds and degradation use an injectable clock and a
+  manual ``step()`` loop, so no test sleeps or races;
+* the AOT executable cache never compiles more than ``len(ladder)``
+  programs per (family, k, dtype, level) under mixed-shape traffic —
+  the zero-recompilation guard the subsystem exists for.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq
+from raft_tpu.serve import (DEFAULT_LADDER, DeadlineExceeded, QueueFull,
+                            SearchServer, ServerConfig, bucket_for,
+                            family_of, normalize_ladder)
+from raft_tpu.serve.admission import AdmissionController, AdmissionPolicy
+from raft_tpu.serve.batcher import Request, plan_batch
+from raft_tpu.serve.bucketing import pad_rows, split_rows
+from raft_tpu.serve.metrics import ServingMetrics, percentile
+from raft_tpu.serve.searchers import BruteForceSearchParams
+from raft_tpu.core.errors import RaftError
+
+
+class FakeClock:
+    """Deterministic monotonic clock for deadline tests."""
+
+    def __init__(self, t: float = 100.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# pure planning logic
+
+
+def test_normalize_ladder():
+    assert normalize_ladder((512, 1, 8, 8, 64)) == (1, 8, 64, 512)
+    assert normalize_ladder([3]) == (3,)
+    with pytest.raises(RaftError):
+        normalize_ladder(())
+    with pytest.raises(RaftError):
+        normalize_ladder((0, 4))
+
+
+def test_bucket_for():
+    lad = (1, 8, 64, 512)
+    assert bucket_for(1, lad) == 1
+    assert bucket_for(2, lad) == 8
+    assert bucket_for(8, lad) == 8
+    assert bucket_for(9, lad) == 64
+    assert bucket_for(512, lad) == 512
+    assert bucket_for(513, lad) is None
+
+
+def test_split_rows():
+    assert split_rows(1000, 512) == [512, 488]
+    assert split_rows(512, 512) == [512]
+    assert split_rows(3, 512) == [3]
+
+
+def test_pad_rows_zero_pad_and_noop():
+    q = np.arange(6, dtype=np.float32).reshape(2, 3)
+    out = pad_rows(q, 5)
+    assert out.shape == (5, 3)
+    np.testing.assert_array_equal(out[:2], q)
+    np.testing.assert_array_equal(out[2:], 0)
+    assert pad_rows(q, 2) is q  # full bucket: no copy
+    with pytest.raises(RaftError):
+        pad_rows(q, 1)
+
+
+def _req(rows, k=5, dtype=np.float32, deadline=1e9):
+    from concurrent.futures import Future
+
+    return Request(np.zeros((rows, 4), dtype=dtype), k, deadline, 0.0,
+                   future=Future())
+
+
+def test_plan_batch_coalesces_fifo_prefix():
+    pending = [_req(3), _req(2), _req(1)]
+    take, bucket = plan_batch(pending, (1, 8, 64))
+    assert take == pending  # all fit in 8
+    assert bucket == 8
+
+
+def test_plan_batch_skips_incompatible_but_preserves_order():
+    a, b, c = _req(3, k=5), _req(2, k=7), _req(1, k=5)
+    take, bucket = plan_batch([a, b, c], (1, 8))
+    assert take == [a, c]  # b (different k) keeps its queue slot
+    assert bucket == 8
+    d = _req(2, dtype=np.float64)
+    take, _ = plan_batch([a, d, c], (1, 8))
+    assert take == [a, c]  # dtype splits the batch too
+
+
+def test_plan_batch_respects_max_bucket():
+    pending = [_req(6), _req(6), _req(6)]
+    take, bucket = plan_batch(pending, (1, 8))
+    assert take == [pending[0]]  # 6+6 > 8 stops the fill
+    assert bucket == 8
+
+
+# ---------------------------------------------------------------------------
+# admission + metrics units
+
+
+def test_admission_levels_and_deadline():
+    ctl = AdmissionController(AdmissionPolicy(
+        max_queue=10, default_deadline_ms=250.0,
+        degrade_queue_fractions=(0.5, 0.8)))
+    assert ctl.admit(9) and not ctl.admit(10)
+    assert [ctl.level(d) for d in (0, 4, 5, 7, 8, 10)] == [0, 0, 1, 1, 2, 2]
+    assert ctl.deadline(2.0, None) == pytest.approx(2.25)
+    assert ctl.deadline(2.0, 100.0) == pytest.approx(2.1)
+    with pytest.raises(RaftError):
+        ctl.deadline(0.0, -5.0)
+
+
+def test_admission_policy_validation():
+    with pytest.raises(RaftError):
+        AdmissionPolicy(max_queue=0)
+    with pytest.raises(RaftError):
+        AdmissionPolicy(degrade_queue_fractions=(0.8, 0.5))
+    with pytest.raises(RaftError):
+        AdmissionPolicy(degrade_queue_fractions=(0.0,))
+
+
+def test_server_config_validation():
+    with pytest.raises(RaftError):
+        ServerConfig(degrade_effort_scales=(1.0, 0.5))  # count mismatch
+    with pytest.raises(RaftError):
+        ServerConfig(degrade_effort_scales=(0.9, 0.5, 0.25))  # level 0 != 1.0
+
+
+def test_percentile_nearest_rank():
+    assert percentile([], 50) == 0.0
+    assert percentile([7.0], 99) == 7.0
+    vals = sorted(float(v) for v in range(1, 101))
+    assert percentile(vals, 50) == 50.0
+    assert percentile(vals, 95) == 95.0
+    assert percentile(vals, 99) == 99.0
+
+
+def test_metrics_snapshot_schema():
+    m = ServingMetrics(latency_window=8)
+    m.count("submitted", 3)
+    m.observe_batch(bucket=8, rows=5, level=1)
+    m.observe_latency(10.0)
+    m.observe_latency(20.0, late=True)
+    snap = m.snapshot()
+    assert snap["submitted"] == 3 and snap["completed"] == 2
+    assert snap["batch_fill_ratio"] == pytest.approx(5 / 8)
+    assert snap["late_completions"] == 1
+    assert snap["degrade_dispatches"] == {"1": 1}
+    assert snap["latency_ms"]["max"] == 20.0
+    text = m.to_json(extra={"queue_depth": 0})
+    assert '"queue_depth"' in text
+
+
+# ---------------------------------------------------------------------------
+# bit-identity vs direct search() — all four families
+
+
+N, D, K = 192, 16, 4
+
+
+@pytest.fixture(scope="module")
+def db():
+    return np.random.default_rng(7).standard_normal((N, D)).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def queries(db):
+    return np.random.default_rng(8).standard_normal((7, D)).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def built(db):
+    """index + params + direct-search closure per family."""
+    fi = ivf_flat.build(db, ivf_flat.IvfFlatIndexParams(n_lists=6))
+    fp = ivf_flat.IvfFlatSearchParams(n_probes=3)
+    pi = ivf_pq.build(db, ivf_pq.IvfPqIndexParams(n_lists=6, pq_dim=8,
+                                                  pq_bits=4))
+    pp = ivf_pq.IvfPqSearchParams(n_probes=3)
+    ci = cagra.build(db, cagra.CagraIndexParams(graph_degree=8))
+    cp = cagra.CagraSearchParams(itopk_size=16)
+    return {
+        "brute_force": (db, None,
+                        lambda q: brute_force.knn(q, db, k=K)),
+        "ivf_flat": (fi, fp, lambda q: ivf_flat.search(fi, q, K, params=fp)),
+        "ivf_pq": (pi, pp, lambda q: ivf_pq.search(pi, q, K, params=pp)),
+        "cagra": (ci, cp, lambda q: cagra.search(ci, q, K, params=cp)),
+    }
+
+
+@pytest.mark.parametrize("family", ["brute_force", "ivf_flat", "ivf_pq",
+                                    "cagra"])
+def test_served_results_bit_identical(built, queries, family):
+    index, params, direct = built[family]
+    assert family_of(index) == family
+    d0, i0 = direct(queries)
+    srv = SearchServer(index, k=K, params=params,
+                       config=ServerConfig(ladder=(2, 8, 32)))
+    d, i = srv.search(queries)  # step-driven (no thread): deterministic
+    # exact equality — the padded-bucket executable must not perturb rows
+    np.testing.assert_array_equal(np.asarray(i0), i)
+    np.testing.assert_array_equal(np.asarray(d0), d)
+
+
+def test_single_query_1d_and_split_requests(db):
+    srv = SearchServer(db, k=K, config=ServerConfig(ladder=(2, 8)))
+    d, i = srv.search(db[3])  # 1-D query promotes to (1, d)
+    assert d.shape == (1, K) and i[0, 0] == 3
+    big = np.random.default_rng(9).standard_normal((19, D)).astype(np.float32)
+    d0, i0 = brute_force.knn(big, db, k=K)
+    d, i = srv.search(big)  # 19 rows > max bucket 8: split into 8+8+3
+    np.testing.assert_array_equal(np.asarray(i0), i)
+    np.testing.assert_array_equal(np.asarray(d0), d)
+    assert srv.metrics.batches >= 3
+
+
+def test_submit_validation(db):
+    srv = SearchServer(db, k=K)
+    with pytest.raises(RaftError):
+        srv.submit(np.zeros((2, D + 1), np.float32))  # dim mismatch
+    with pytest.raises(RaftError):
+        srv.submit(db[:2], k=N + 1)  # k > index rows
+    with pytest.raises(RaftError):
+        SearchServer(db, k=0)
+
+
+# ---------------------------------------------------------------------------
+# deadlines, queue bounds, degradation — fake clock, manual step()
+
+
+def test_deadline_expiry_rejects_before_dispatch(db):
+    clock = FakeClock()
+    srv = SearchServer(db, k=K, config=ServerConfig(ladder=(4,)),
+                       clock=clock)
+    fut = srv.submit(db[:2], deadline_ms=50.0)
+    clock.advance(0.051)  # deadline passes while queued
+    retired = srv.step()
+    assert retired == 1
+    with pytest.raises(DeadlineExceeded):
+        fut.result(timeout=0)
+    assert srv.metrics.rejected_deadline == 1
+    assert srv.metrics.batches == 0  # never reached the accelerator
+
+
+def test_deadline_not_expired_completes(db):
+    clock = FakeClock()
+    srv = SearchServer(db, k=K, config=ServerConfig(ladder=(4,)),
+                       clock=clock)
+    fut = srv.submit(db[:2], deadline_ms=50.0)
+    clock.advance(0.010)
+    srv.step()
+    d, i = fut.result(timeout=0)
+    assert i.shape == (2, K)
+    assert srv.metrics.completed == 1 and srv.metrics.late_completions == 0
+
+
+def test_queue_full_rejects_at_submit(db):
+    srv = SearchServer(db, k=K, config=ServerConfig(max_queue=2,
+                                                    ladder=(4,)),
+                       clock=FakeClock())
+    srv.submit(db[:1])
+    srv.submit(db[:1])
+    with pytest.raises(QueueFull):
+        srv.submit(db[:1])
+    assert srv.metrics.rejected_queue_full == 1
+    # draining the queue restores admission
+    while srv.step():
+        pass
+    srv.submit(db[:1])
+
+
+def test_degradation_activates_under_pressure(db):
+    cfg = ServerConfig(max_queue=4, ladder=(1,), max_wait_ms=0.0,
+                       degrade_queue_fractions=(0.5, 0.75),
+                       degrade_effort_scales=(1.0, 0.5, 0.25))
+    srv = SearchServer(db, k=K,
+                       params=BruteForceSearchParams(mode="fast", cand=32),
+                       config=cfg, clock=FakeClock())
+    for _ in range(4):  # depth 4 >= 0.75*4: level 2
+        srv.submit(db[:1])
+    srv.step()
+    assert srv.metrics.degrade_dispatches.get(2) == 1
+    # pressure released: the tail of the queue drains at lower levels
+    while srv.step():
+        pass
+    assert 0 in srv.metrics.degrade_dispatches
+    # degraded dispatches still return k valid neighbors
+    snap = srv.metrics_snapshot()
+    assert snap["completed"] == 4 and snap["latency_ms"]["count"] == 4
+
+
+def test_degraded_search_returns_valid_topk(db):
+    idx = ivf_flat.build(db, ivf_flat.IvfFlatIndexParams(n_lists=8))
+    cfg = ServerConfig(max_queue=2, ladder=(2,),
+                       degrade_queue_fractions=(0.9,),
+                       degrade_effort_scales=(1.0, 0.25))
+    srv = SearchServer(idx, k=K,
+                       params=ivf_flat.IvfFlatSearchParams(n_probes=8),
+                       config=cfg, clock=FakeClock())
+    futs = [srv.submit(db[:2]), srv.submit(db[:2])]  # depth 2 -> level 1
+    while srv.step():
+        pass
+    d, i = futs[0].result(timeout=0)
+    assert i.shape == (2, K) and (np.asarray(i) >= 0).all()
+    assert srv.metrics.degrade_dispatches.get(1, 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# AOT cache guard — the zero-recompilation contract
+
+
+def test_mixed_shape_workload_never_recompiles(db):
+    """>= 200 mixed-shape requests after warmup must be served entirely by
+    the precompiled ladder: compiles == len(ladder), misses == compiles."""
+    ladder = (1, 8, 64)
+    srv = SearchServer(db, k=K, config=ServerConfig(ladder=ladder))
+    assert srv.warmup() == len(ladder)
+    assert srv.warmup() == 0  # idempotent
+    rng = np.random.default_rng(11)
+    futs = []
+    for _ in range(200):
+        rows = int(rng.integers(1, 40))
+        q = rng.standard_normal((rows, D)).astype(np.float32)
+        futs.append((q, srv.submit(q)))
+        while len(srv._pending) >= 32:  # keep under max_queue
+            srv.step()
+    while srv.step():
+        pass
+    for q, fut in futs:
+        d, i = fut.result(timeout=0)
+        assert i.shape == (q.shape[0], K)
+    assert srv.metrics.completed == 200
+    assert srv.cache.compiles == len(ladder)  # warmup only — zero extra
+    assert srv.cache.hits >= srv.metrics.batches
+    snap = srv.metrics_snapshot()
+    assert snap["cache"]["compiles"] == len(ladder)
+    assert 0 < snap["batch_fill_ratio"] <= 1.0
+
+
+def test_distinct_k_gets_its_own_executables(db):
+    srv = SearchServer(db, k=K, config=ServerConfig(ladder=(4,)))
+    srv.warmup()
+    srv.search(db[:2])
+    assert srv.cache.compiles == 1
+    srv.search(db[:2], k=K + 1)  # new cache coordinate: one more compile
+    assert srv.cache.compiles == 2
+    srv.search(db[:3], k=K + 1)  # same coordinate: cache hit
+    assert srv.cache.compiles == 2
+
+
+# ---------------------------------------------------------------------------
+# threaded smoke — real clock, real dispatch thread
+
+
+def test_threaded_server_smoke(db):
+    d0, i0 = brute_force.knn(db[:6], db, k=K)
+    with SearchServer(db, k=K,
+                      config=ServerConfig(ladder=(1, 8),
+                                          max_wait_ms=1.0)) as srv:
+        results = [None] * 4
+        def client(j):
+            results[j] = srv.search(db[:6])
+        threads = [threading.Thread(target=client, args=(j,))
+                   for j in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        snap = srv.metrics_snapshot()
+    for d, i in results:
+        np.testing.assert_array_equal(np.asarray(i0), i)
+        np.testing.assert_array_equal(np.asarray(d0), d)
+    assert snap["completed"] == 4
+    assert snap["cache"]["compiles"] <= 2  # the warmed ladder, nothing more
+    assert DEFAULT_LADDER == (1, 8, 64, 512)
+
+
+# ---------------------------------------------------------------------------
+# bench driver wiring
+
+
+def test_bench_serve_emits_final_json_line():
+    """bench/serve.py end-to-end at smoke scale: final line is the
+    driver-format metric and the cache census shows zero recompilation."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    bench = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench", "serve.py")
+    env = dict(os.environ)
+    env.update({"RAFT_BENCH_PLATFORM": "cpu", "JAX_PLATFORMS": "cpu",
+                "RAFT_BENCH_SERVE_ROWS": "2000",
+                "RAFT_BENCH_SERVE_DIM": "16",
+                "RAFT_BENCH_SERVE_SECONDS": "0.5",
+                "RAFT_BENCH_SERVE_CLIENTS": "2",
+                "RAFT_BENCH_SERVE_LADDER": "1,8"})
+    p = subprocess.run([sys.executable, bench], capture_output=True,
+                       text=True, timeout=300, env=env)
+    assert p.returncode == 0, p.stderr
+    lines = [ln for ln in p.stdout.splitlines() if ln.startswith("{")]
+    final = json.loads(lines[-1])
+    assert final["metric"] == "serve_qps_at_p95_budget"
+    assert final["value"] > 0
+    assert final["unit"].startswith("qps@p95")
+    assert final["serving_metrics"]["cache"]["compiles"] == 2  # len(ladder)
+    assert final["serving_metrics"]["rejected_queue_full"] == 0
