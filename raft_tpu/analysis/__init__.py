@@ -1,0 +1,31 @@
+"""raft_tpu.analysis — static hazard analysis for the library's hot paths.
+
+The reference keeps itself honest with compile-time discipline (every
+header compiled in every consumption mode, ``cpp/tests/CMakeLists.txt``
+ext_headers).  Our equivalent failure class is JAX-specific: silent host
+syncs, per-call recompilation, and dtype leaks that CPU-pinned tests
+never see.  :mod:`.jaxlint` is the AST pass that gates them; the runtime
+side (``raft_tpu.core.trace_guard``) asserts the same properties on live
+dispatches.  Rule catalog: ``docs/jax_hygiene.md``.
+
+This package imports only the standard library (no jax) so lint tooling
+can load it without touching an accelerator backend.
+"""
+
+from .jaxlint import (
+    ALL_RULES,
+    Finding,
+    Report,
+    scan_file,
+    scan_source,
+    scan_tree,
+)
+
+__all__ = [
+    "ALL_RULES",
+    "Finding",
+    "Report",
+    "scan_file",
+    "scan_source",
+    "scan_tree",
+]

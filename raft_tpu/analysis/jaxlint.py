@@ -1,0 +1,547 @@
+"""jaxlint — AST rules for the JAX/TPU hazard classes that CPU-pinned
+tests never see.
+
+The five rules (catalog with bad/good snippets: ``docs/jax_hygiene.md``):
+
+* **JX01** host sync in library code — ``float()``/``int()``/``bool()``/
+  ``.item()``/``.tolist()``/``np.asarray()``/``jax.device_get()`` applied
+  to a value derived from ``jax``/``jnp``.  Each sync stalls the dispatch
+  pipeline for a device round-trip; on TPU that is the difference between
+  a saturated MXU and a host-bound loop.  Host-boundary modules
+  (``serve/``, ``io/``, ``compat/`` and the ``core`` transfer helpers)
+  are exempt: fetching results *is* their job.
+* **JX02** recompilation hazard — Python ``if``/``while`` on a
+  tracer-derived value inside a jitted function (concretization →
+  retrace per value), ``jax.jit(f)(x)`` immediate invocation, or a
+  ``jax.jit`` call inside a loop (a fresh jit wrapper per iteration has
+  a fresh cache: every call compiles).
+* **JX03** dtype hygiene — explicit ``float64``/``np.double`` requests
+  that silently downcast to f32 with x64 off (and double memory traffic
+  with it on).  Usages gated on ``jax_enable_x64`` are recognized and
+  skipped.
+* **JX04** impure host call inside jit — ``np.random``/``random``/
+  ``time`` calls in a jitted function bake one sample/timestamp into the
+  compiled program: correct-looking on the first call, frozen forever
+  after.
+* **JX05** blocking call — ``block_until_ready`` outside ``serve/``,
+  ``bench/``, ``scripts/``: library code must stay async; only drivers
+  and the serving dispatch own completion barriers.
+
+Per-line waivers::
+
+    res = float(residual)  # jaxlint: disable=JX01 one scalar sync per convergence check
+
+The reason text is mandatory — a bare ``disable=`` is itself a finding
+(**JXW0**, not waivable), so every exemption in the tree carries a
+written justification a reviewer can audit.
+
+Pure standard library (``ast``); importable without jax so lint tooling
+stays accelerator-free.  Entry point: ``python scripts/mini_lint.py
+--jax raft_tpu``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["ALL_RULES", "Finding", "Report", "scan_source", "scan_file",
+           "scan_tree"]
+
+ALL_RULES: Dict[str, str] = {
+    "JX01": "host sync in library code",
+    "JX02": "recompilation hazard",
+    "JX03": "float64 dtype leak",
+    "JX04": "impure host call inside jit",
+    "JX05": "blocking call outside serve/bench/scripts",
+    "JXW0": "waiver without a written reason",
+}
+
+# Directory segments / file suffixes whose job is the host boundary.
+_JX01_ALLOW_SEGMENTS = {"serve", "io", "compat", "bench", "scripts", "tests"}
+_JX01_ALLOW_FILES = (
+    "core/array.py",       # to_numpy is the sanctioned fetch
+    "core/copy.py",        # explicit H<->D copy API
+    "core/serialize.py",   # serialization is a host format
+    "core/host_memory.py",
+    "core/buffer.py",      # memory_type dispatch spans host/device
+    "core/memory.py",      # live-bytes accounting reads device stats
+    "core/interruptible.py",  # sync points are its purpose
+    "comms/selftest.py",   # diagnostic harness: verifying collectives on
+                           # the host is the module's entire job
+)
+_JX05_ALLOW_SEGMENTS = {"serve", "bench", "scripts", "tests"}
+_JX05_ALLOW_FILES = ("core/interruptible.py", "core/resources.py")
+
+_WAIVER_RE = re.compile(
+    r"#\s*jaxlint:\s*disable=([A-Z0-9]+(?:\s*,\s*[A-Z0-9]+)*)\s*(.*)")
+
+_SYNC_BUILTINS = {"float", "int", "bool", "complex"}
+_SYNC_METHODS = {"item", "tolist"}
+_JAX_ROOTS = {"jax", "jnp"}
+_TIME_ATTRS = {"time", "perf_counter", "monotonic", "process_time",
+               "thread_time", "sleep", "perf_counter_ns", "time_ns",
+               "monotonic_ns"}
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule hit.  ``waived`` hits are kept for stats but do not fail
+    the lint; ``reason`` carries the waiver's justification text."""
+
+    path: str
+    line: int
+    code: str
+    msg: str
+    waived: bool = False
+    reason: str = ""
+
+
+@dataclasses.dataclass
+class Report:
+    """Tree-scan result: active findings, audited waivers, file count."""
+
+    findings: List[Finding]
+    waived: List[Finding]
+    files: int
+
+    def rules_fired(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.findings + self.waived:
+            out[f.code] = out.get(f.code, 0) + 1
+        return out
+
+    def stats(self) -> dict:
+        """The ``bench/JAXLINT.json`` schema — the artifact re-anchor
+        reviewers watch for the waiver count trending to zero."""
+        waivers: Dict[str, int] = {}
+        for f in self.waived:
+            waivers[f.code] = waivers.get(f.code, 0) + 1
+        return {
+            "tool": "jaxlint",
+            "files_scanned": self.files,
+            "rules_fired": self.rules_fired(),
+            "unwaived_findings": len(self.findings),
+            "waivers": waivers,
+            "waiver_total": len(self.waived),
+            "waiver_sites": sorted(
+                f"{f.path}:{f.line} {f.code} {f.reason}" for f in self.waived),
+            "rule_catalog": dict(ALL_RULES),
+        }
+
+
+# ---------------------------------------------------------------------------
+# helpers
+
+
+def _rel_segments(rel: Optional[str]) -> Tuple[set, str]:
+    rel = (rel or "").replace(os.sep, "/")
+    return set(rel.split("/")[:-1]), rel
+
+
+_STATIC_ATTRS = {"ndim", "shape", "dtype", "size", "sharding", "itemsize",
+                 "weak_type"}
+# jax/jnp callables whose results are host values known at trace time —
+# neither a sync hazard nor a retrace hazard
+_STATIC_CALLS = {"issubdtype", "isdtype", "result_type", "promote_types",
+                 "canonicalize_dtype", "dtype", "iinfo", "finfo",
+                 "default_backend", "devices", "device_count",
+                 "local_device_count", "local_devices", "process_index",
+                 "process_count"}
+# dtype-valued attributes (jnp.int8, np.float32, ...): static objects, not
+# traced arrays — comparing against them must not taint a name
+_DTYPE_ATTRS = {"float16", "float32", "bfloat16", "int8", "uint8", "int16",
+                "uint16", "int32", "uint32", "int64", "uint64", "bool_",
+                "complex64", "complex128", "integer", "floating", "inexact",
+                "signedinteger", "unsignedinteger", "number", "generic"}
+
+
+def _is_sync_sink(call: ast.Call) -> bool:
+    """``float(x)`` / ``x.item()`` / ``np.asarray(x)`` / ``jax.device_get``
+    — calls whose result lives on the host."""
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id in _SYNC_BUILTINS
+    if isinstance(fn, ast.Attribute):
+        if fn.attr in _SYNC_METHODS or fn.attr == "device_get":
+            return True
+        chain = _attr_chain(fn)
+        return bool(chain) and chain[0] in ("np", "numpy") \
+            and chain[-1] in ("asarray", "array")
+    return False
+
+
+def _mentions_jax(node: ast.AST, tainted: set) -> bool:
+    """True when the expression subtree references jax/jnp or a name
+    assigned from such an expression (one-hop local dataflow).
+
+    Accesses through static metadata (``x.shape[0]``, ``x.ndim``,
+    ``x.dtype``, ``jnp.issubdtype(...)``, ``jax.default_backend()``) are
+    *not* traced values — they are known at trace time and neither sync
+    nor retrace — so their subtrees are excluded before the name check."""
+    excluded: set = set()
+    for sub in ast.walk(node):
+        static = isinstance(sub, ast.Attribute) \
+            and sub.attr in (_STATIC_ATTRS | _DTYPE_ATTRS)
+        if isinstance(sub, ast.Call):
+            fn = sub.func
+            if isinstance(fn, ast.Attribute) and fn.attr in _STATIC_CALLS:
+                static = True
+            # a sync sink's RESULT is a host value: taint stops there (the
+            # sink call itself is still checked by the JX01 visitor)
+            elif _is_sync_sink(sub):
+                static = True
+        if static:
+            for leaf in ast.walk(sub):
+                excluded.add(id(leaf))
+    for sub in ast.walk(node):
+        if id(sub) in excluded:
+            continue
+        if isinstance(sub, ast.Name) and (sub.id in _JAX_ROOTS
+                                          or sub.id in tainted):
+            return True
+    return False
+
+
+def _attr_chain(node: ast.AST) -> List[str]:
+    """``jax.random.fold_in`` -> ["jax", "random", "fold_in"]; [] when the
+    chain does not bottom out in a Name."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return []
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """Matches ``jit`` / ``jax.jit`` / ``partial(jax.jit, ...)`` /
+    ``functools.partial(jax.jit, ...)``."""
+    if isinstance(node, ast.Name):
+        return node.id == "jit"
+    if isinstance(node, ast.Attribute):
+        chain = _attr_chain(node)
+        return bool(chain) and chain[-1] == "jit"
+    if isinstance(node, ast.Call):
+        chain = _attr_chain(node.func)
+        if chain and chain[-1] == "partial" and node.args:
+            return _is_jit_expr(node.args[0])
+    return False
+
+
+def _scope_nodes(body: List[ast.stmt]):
+    """All nodes of a scope's own statements, descending through control
+    flow but NOT into nested function/class/lambda scopes — their locals
+    must not leak taint into this one."""
+    stack: List[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _collect_taint(fn_body: List[ast.stmt]) -> set:
+    """Names assigned from jax-derived expressions in this scope.  Two
+    passes give one-hop transitivity (``y = f(x); z = y + 1``) without a
+    fixpoint loop; nested scopes are excluded (their locals are not ours)."""
+    tainted: set = set()
+    for _ in range(2):
+        for stmt in fn_body:
+            for sub in _scope_nodes([stmt]):
+                value = None
+                targets: List[ast.AST] = []
+                if isinstance(sub, ast.Assign):
+                    value, targets = sub.value, list(sub.targets)
+                elif isinstance(sub, ast.AnnAssign) and sub.value is not None:
+                    value, targets = sub.value, [sub.target]
+                elif isinstance(sub, ast.AugAssign):
+                    value, targets = sub.value, [sub.target]
+                if value is None or not _mentions_jax(value, tainted):
+                    continue
+                for t in targets:
+                    # only plain-name bindings: `obj.attr = v` / `x[i] = v`
+                    # must not taint `obj`/`x` (the container is unchanged
+                    # as a name; attribute loads are checked at use sites)
+                    for leaf in ast.walk(t):
+                        if isinstance(leaf, (ast.Attribute, ast.Subscript)):
+                            break
+                    else:
+                        for leaf in ast.walk(t):
+                            if isinstance(leaf, ast.Name):
+                                tainted.add(leaf.id)
+    return tainted
+
+
+class _Scanner(ast.NodeVisitor):
+    def __init__(self, rel: str) -> None:
+        self.rel = rel
+        segs, relpath = _rel_segments(rel)
+        base = os.path.basename(relpath)
+        self.jx01_exempt = bool(segs & _JX01_ALLOW_SEGMENTS) or any(
+            relpath.endswith(f) for f in _JX01_ALLOW_FILES) \
+            or base.startswith("test_") or base == "conftest.py"
+        self.jx05_exempt = bool(segs & _JX05_ALLOW_SEGMENTS) or any(
+            relpath.endswith(f) for f in _JX05_ALLOW_FILES) \
+            or base.startswith("test_") or base == "conftest.py"
+        self.raw: List[Tuple[int, int, str, str]] = []  # (line, end, code, msg)
+        self._jit_depth = 0
+        self._loop_depth = 0
+        self._x64_guard = 0
+        self._taint: List[set] = [set()]
+
+    # -- bookkeeping --------------------------------------------------------
+
+    def _hit(self, node: ast.AST, code: str, msg: str) -> None:
+        self.raw.append((node.lineno, getattr(node, "end_lineno",
+                                              node.lineno), code, msg))
+
+    def _tainted(self) -> set:
+        return self._taint[-1]
+
+    def visit_FunctionDef(self, node):  # noqa: N802 (ast API)
+        jitted = any(_is_jit_expr(d) for d in node.decorator_list)
+        self._jit_depth += 1 if jitted else 0
+        scope = set(self._tainted())
+        scope |= _collect_taint(node.body)
+        self._taint.append(scope)
+        for d in node.decorator_list:
+            self.visit(d)
+        for stmt in node.body:
+            self.visit(stmt)
+        self._taint.pop()
+        self._jit_depth -= 1 if jitted else 0
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Module(self, node):  # noqa: N802
+        self._taint[0] |= _collect_taint(node.body)
+        self.generic_visit(node)
+
+    def _loop(self, node):
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    # -- JX02a: tracer control flow / x64 gates -----------------------------
+
+    def _test_mentions_x64(self, test: ast.AST) -> bool:
+        return any(isinstance(s, (ast.Attribute, ast.Name))
+                   and "enable_x64" in (getattr(s, "attr", "")
+                                        or getattr(s, "id", ""))
+                   for s in ast.walk(test))
+
+    def _check_branch(self, node, kind: str) -> bool:
+        """Returns True when the branch is an x64 gate (suppresses JX03
+        inside)."""
+        if self._test_mentions_x64(node.test):
+            return True
+        if self._jit_depth > 0 and not (
+                isinstance(node.test, ast.Compare)
+                and any(isinstance(op, (ast.Is, ast.IsNot))
+                        for op in node.test.ops)):
+            if _mentions_jax(node.test, self._tainted()):
+                self._hit(node, "JX02",
+                          f"Python `{kind}` on a traced value inside jit —"
+                          " concretizes the tracer (retrace per value); use"
+                          " lax.cond/while_loop or jnp.where")
+        return False
+
+    def visit_If(self, node):  # noqa: N802
+        gate = self._check_branch(node, "if")
+        self._x64_guard += 1 if gate else 0
+        self.generic_visit(node)
+        self._x64_guard -= 1 if gate else 0
+
+    def visit_IfExp(self, node):  # noqa: N802
+        gate = self._test_mentions_x64(node.test)
+        static_none = isinstance(node.test, ast.Compare) and any(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in node.test.ops)
+        if not gate and not static_none and self._jit_depth > 0 \
+                and _mentions_jax(node.test, self._tainted()):
+            self._hit(node, "JX02",
+                      "conditional expression on a traced value inside jit;"
+                      " use jnp.where")
+        self._x64_guard += 1 if gate else 0
+        self.generic_visit(node)
+        self._x64_guard -= 1 if gate else 0
+
+    def visit_While(self, node):  # noqa: N802
+        self._check_branch(node, "while")
+        self._loop(node)
+
+    visit_For = _loop
+    visit_AsyncFor = _loop
+
+    # -- expression rules ---------------------------------------------------
+
+    def visit_Attribute(self, node):  # noqa: N802
+        chain = _attr_chain(node)
+        if chain:
+            dotted = ".".join(chain)
+            if chain[-1] in ("float64", "double") \
+                    and chain[0] in ("np", "numpy", "jnp", "jax") \
+                    and self._x64_guard == 0:
+                self._hit(node, "JX03",
+                          f"{dotted}: silently downcasts to f32 with x64"
+                          " off (or doubles memory traffic with it on);"
+                          " request an explicit f32/bf16 dtype")
+            if self._jit_depth > 0:
+                # fire on the `np.random` node itself, not again on every
+                # enclosing `np.random.<fn>` attribute above it
+                if chain[:2] in (["np", "random"], ["numpy", "random"]) \
+                        and len(chain) == 2:
+                    self._hit(node, "JX04",
+                              f"{dotted} inside jit bakes one sample into"
+                              " the compiled program; thread a"
+                              " jax.random key instead")
+                elif chain[0] == "random" and len(chain) > 1:
+                    self._hit(node, "JX04",
+                              f"stdlib {dotted} inside jit bakes one sample"
+                              " into the compiled program; thread a"
+                              " jax.random key instead")
+                elif chain[0] == "time" and chain[-1] in _TIME_ATTRS:
+                    self._hit(node, "JX04",
+                              f"{dotted} inside jit freezes one timestamp"
+                              " into the compiled program; time on the"
+                              " host, outside jit")
+        self.generic_visit(node)
+
+    def visit_Call(self, node):  # noqa: N802
+        tainted = self._tainted()
+        fn = node.func
+        # JX01 — host syncs
+        if not self.jx01_exempt:
+            if isinstance(fn, ast.Name) and fn.id in _SYNC_BUILTINS \
+                    and len(node.args) == 1 \
+                    and _mentions_jax(node.args[0], tainted):
+                self._hit(node, "JX01",
+                          f"{fn.id}() on a jax value forces a blocking"
+                          " device->host sync; keep it on-device"
+                          " (jnp.where / lax.cond) or fetch once at the"
+                          " API boundary")
+            elif isinstance(fn, ast.Attribute):
+                chain = _attr_chain(fn)
+                if fn.attr in _SYNC_METHODS \
+                        and _mentions_jax(fn.value, tainted):
+                    self._hit(node, "JX01",
+                              f".{fn.attr}() on a jax value is a blocking"
+                              " device->host sync")
+                elif chain and chain[0] in ("np", "numpy") \
+                        and chain[-1] in ("asarray", "array") \
+                        and node.args \
+                        and _mentions_jax(node.args[0], tainted):
+                    self._hit(node, "JX01",
+                              "np.asarray/np.array on a jax value is a"
+                              " blocking device->host transfer")
+                elif chain and chain[0] == "jax" \
+                        and chain[-1] == "device_get":
+                    self._hit(node, "JX01",
+                              "jax.device_get is a blocking device->host"
+                              " transfer")
+        # JX02b — jit misuse
+        if isinstance(fn, ast.Call) and _is_jit_expr(fn.func):
+            self._hit(node, "JX02",
+                      "jax.jit(f)(args) compiles a fresh wrapper per call"
+                      " (empty cache every time); jit once at def site or"
+                      " cache the wrapper")
+        if _is_jit_expr(fn) and self._loop_depth > 0:
+            self._hit(node, "JX02",
+                      "jax.jit inside a loop creates a new wrapper (and"
+                      " empty compile cache) per iteration; hoist it out")
+        # JX05 — completion barriers
+        if not self.jx05_exempt:
+            attr = fn.attr if isinstance(fn, ast.Attribute) else \
+                (fn.id if isinstance(fn, ast.Name) else "")
+            if attr == "block_until_ready":
+                self._hit(node, "JX05",
+                          "block_until_ready in library code serializes"
+                          " the dispatch pipeline; only serve/, bench/,"
+                          " scripts/ own completion barriers")
+        self.generic_visit(node)
+
+
+# ---------------------------------------------------------------------------
+# waivers + entry points
+
+
+def _waivers_by_line(src: str) -> Dict[int, Tuple[set, str]]:
+    out: Dict[int, Tuple[set, str]] = {}
+    for i, line in enumerate(src.split("\n"), 1):
+        m = _WAIVER_RE.search(line)
+        if m:
+            codes = {c.strip() for c in m.group(1).split(",")}
+            out[i] = (codes, m.group(2).strip())
+    return out
+
+
+def scan_source(src: str, path: str, rel: Optional[str] = None
+                ) -> List[Finding]:
+    """Scan one source string; returns all findings, waived ones marked.
+
+    ``rel`` is the path relative to the scan root (used for the
+    host-boundary allowlists); defaults to ``path``.
+    """
+    try:
+        tree = ast.parse(src, path)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 0, "JX99",
+                        f"unparseable: {e.msg}")]
+    scanner = _Scanner(rel if rel is not None else path)
+    scanner.visit(tree)
+    waivers = _waivers_by_line(src)
+    findings: List[Finding] = []
+    consumed: set = set()
+    for line, end, code, msg in sorted(scanner.raw):
+        waived, reason = False, ""
+        for cand in (line, end):
+            codes_reason = waivers.get(cand)
+            if codes_reason and code in codes_reason[0]:
+                waived, reason = True, codes_reason[1]
+                consumed.add(cand)
+                break
+        findings.append(Finding(path, line, code, msg, waived, reason))
+    for line, (codes, reason) in sorted(waivers.items()):
+        if not reason:
+            findings.append(Finding(
+                path, line, "JXW0",
+                f"waiver for {','.join(sorted(codes))} has no written"
+                " reason; justify it or fix the hazard"))
+    return findings
+
+
+def scan_file(path: str, root: Optional[str] = None) -> List[Finding]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    rel = os.path.relpath(path, root) if root else path
+    return scan_source(src, path, rel)
+
+
+def scan_tree(root: str) -> Report:
+    """Walk ``root`` (skipping caches/VCS dirs) and aggregate a
+    :class:`Report`."""
+    skip = {".git", "__pycache__", ".claude", "node_modules", ".venv"}
+    active: List[Finding] = []
+    waived: List[Finding] = []
+    files = 0
+    base = root if os.path.isdir(root) else os.path.dirname(root) or "."
+    paths = []
+    if os.path.isdir(root):
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames if d not in skip]
+            paths.extend(os.path.join(dirpath, fn)
+                         for fn in filenames if fn.endswith(".py"))
+    else:
+        paths = [root]
+    for path in sorted(paths):
+        files += 1
+        for f in scan_file(path, base):
+            (waived if f.waived else active).append(f)
+    return Report(active, waived, files)

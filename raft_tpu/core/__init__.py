@@ -34,6 +34,7 @@ from .serialize import (
     save_arrays,
     load_arrays,
 )
+from .trace_guard import TraceGuard, SteadyStateError
 from . import interruptible, tracing, logging
 
 __all__ = [
@@ -51,5 +52,6 @@ __all__ = [
     "DeviceResourcesManager", "get_device_resources",
     "serialize_mdspan", "deserialize_mdspan", "serialize_scalar", "deserialize_scalar",
     "save_arrays", "load_arrays",
+    "TraceGuard", "SteadyStateError",
     "interruptible", "tracing", "logging",
 ]

@@ -1,0 +1,147 @@
+"""Runtime trace-guard harness: assert a code region is steady-state.
+
+A "hot" region — a serve loop after warmup, a search path after its first
+call — must neither re-trace/recompile (jit cache misses rebuild XLA
+executables, a multi-second stall on TPU) nor move data between host and
+device (each transfer is a blocking sync that drains the dispatch
+pipeline).  Both hazards are invisible in unit tests on CPU: everything
+still *passes*, just slower, and the cost only lands once the code runs
+against a real TPU.  :class:`TraceGuard` makes them assertable::
+
+    srv.warmup()
+    with TraceGuard() as tg:
+        for q in queries:
+            srv.search(q)
+    tg.assert_steady_state()      # zero traces, zero compiles
+
+How it counts: :mod:`jax.monitoring` fires a duration event on every
+jaxpr trace (``/jax/core/compile/jaxpr_trace_duration``) and every
+backend compile (``/jax/core/compile/backend_compile_duration``) — and
+nothing on a jit-cache hit — so the event count over a region is an
+exact census of cache misses.  ``jax.monitoring`` has no public
+unregister, so ONE module-level listener is registered lazily and
+dispatches to whatever guards are currently active (nesting is fine:
+every active guard sees every event).
+
+Transfers ride :func:`jax.transfer_guard`: ``"disallow"`` raises on any
+implicit host<->device movement inside the region.  Caveat: on the CPU
+backend transfers are zero-copy and the guard never fires — so tests
+assert the trace/compile counters (backend-independent) and merely run
+clean under ``"disallow"``, which becomes a real tripwire on TPU.
+
+The static analyzer (:mod:`raft_tpu.analysis.jaxlint`, JX01/JX02) finds
+these hazards in source; this harness proves their absence at runtime.
+Both gates ship in the same PR on purpose — see docs/jax_hygiene.md.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import jax
+
+__all__ = ["TraceGuard", "SteadyStateError"]
+
+_TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_lock = threading.Lock()
+_active: List["TraceGuard"] = []
+_listener_registered = False
+
+
+class SteadyStateError(AssertionError):
+    """A guarded region traced, compiled, or transferred when it must not."""
+
+
+def _on_event(event: str, duration: float, **kwargs) -> None:
+    if event != _TRACE_EVENT and event != _COMPILE_EVENT:
+        return
+    with _lock:
+        guards = list(_active)
+    for g in guards:
+        g._record(event, kwargs)
+
+
+def _ensure_listener() -> None:
+    # jax.monitoring exposes register but not unregister: install exactly
+    # one permanent listener, route through the active-guard list.
+    global _listener_registered
+    with _lock:
+        if _listener_registered:
+            return
+        _listener_registered = True
+    jax.monitoring.register_event_duration_secs_listener(_on_event)
+
+
+class TraceGuard:
+    """Context manager counting jit cache misses and guarding transfers.
+
+    Parameters
+    ----------
+    transfer : str
+        ``jax.transfer_guard`` mode for the region: ``"disallow"``
+        (default) raises on implicit transfers, ``"log"`` reports them,
+        ``"allow"`` disables the transfer gate (counters still run).
+
+    Attributes (valid during and after the ``with`` block)
+    ------------------------------------------------------
+    traces : int
+        Jaxpr traces observed — the jit cache-miss count.
+    compiles : int
+        Backend (XLA) compiles observed.  ``compiles <= traces``: a
+        trace whose jaxpr hits the persistent compilation cache still
+        counts as a miss of the in-process jit cache.
+    events : list of (event, description) tuples for diagnostics.
+    """
+
+    def __init__(self, transfer: str = "disallow"):
+        self.transfer = transfer
+        self.traces = 0
+        self.compiles = 0
+        self.events: List[tuple] = []
+        self._cm: Optional[object] = None
+
+    # -- listener callback -------------------------------------------------
+    def _record(self, event: str, kwargs: dict) -> None:
+        with _lock:
+            if event == _TRACE_EVENT:
+                self.traces += 1
+            else:
+                self.compiles += 1
+            desc = kwargs.get("fun_name") or kwargs.get("event") or ""
+            self.events.append((event, str(desc)))
+
+    # -- context protocol --------------------------------------------------
+    def __enter__(self) -> "TraceGuard":
+        _ensure_listener()
+        with _lock:
+            _active.append(self)
+        self._cm = jax.transfer_guard(self.transfer)
+        self._cm.__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        cm, self._cm = self._cm, None
+        with _lock:
+            if self in _active:
+                _active.remove(self)
+        return cm.__exit__(exc_type, exc, tb)
+
+    # -- assertions --------------------------------------------------------
+    def assert_steady_state(self, max_traces: int = 0,
+                            max_compiles: int = 0) -> None:
+        """Raise :class:`SteadyStateError` if the region exceeded the
+        allowed trace/compile budget (both default to zero)."""
+        if self.traces > max_traces or self.compiles > max_compiles:
+            detail = "; ".join(f"{e.rsplit('/', 1)[-1]}:{d}"
+                               for e, d in self.events[:8])
+            raise SteadyStateError(
+                f"guarded region not steady-state: {self.traces} trace(s) "
+                f"(allowed {max_traces}), {self.compiles} compile(s) "
+                f"(allowed {max_compiles}) [{detail}]")
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"TraceGuard(transfer={self.transfer!r}, "
+                f"traces={self.traces}, compiles={self.compiles})")

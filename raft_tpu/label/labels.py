@@ -44,7 +44,7 @@ def make_monotonic(
     ``zero_based=False`` starts at 1 like the reference's default.
     """
     y = jnp.asarray(y)
-    yn = np.asarray(y)
+    yn = np.asarray(y)  # jaxlint: disable=JX01 host LUT build: filter_op is an arbitrary Python predicate, values must be concrete
     if filter_op is not None:
         keep = np.asarray([bool(filter_op(v)) for v in yn.tolist()])
     else:
@@ -68,9 +68,8 @@ def merge_labels(labels_a, labels_b, mask) -> jax.Array:
     a = jnp.asarray(labels_a, jnp.int32)
     b = jnp.asarray(labels_b, jnp.int32)
     mask = jnp.asarray(mask, bool)
-    n = a.shape[0]
     # union-find domain: label values (bounded by n+1 per the contract)
-    m = int(max(int(jnp.max(a)), int(jnp.max(b))) + 1)
+    m = int(max(int(jnp.max(a)), int(jnp.max(b))) + 1)  # jaxlint: disable=JX01 union-find domain bound sizes a static-shape parent array; must be a host int
     parent = jnp.arange(m, dtype=jnp.int32)
 
     rounds = max(1, int(np.ceil(np.log2(max(m, 2)))) + 1)

@@ -12,6 +12,7 @@ parity + dtype/shape validation; ``map`` accepts arbitrary Python callables
 
 from __future__ import annotations
 
+import math
 from typing import Callable
 
 import jax.numpy as jnp
@@ -49,7 +50,10 @@ def map(fn: Callable, *arrays):
 def map_offset(fn: Callable, shape, dtype=jnp.int32):
     """Map over flat element offsets (``linalg::map_offset``): ``fn(idx)``
     evaluated for each linear index of ``shape``."""
-    idx = jnp.arange(int(jnp.prod(jnp.asarray(shape))), dtype=dtype)
+    # shape is a host tuple: size it on the host (the former
+    # jnp.prod(jnp.asarray(shape)) round-tripped a static value through
+    # the device just to int() it back)
+    idx = jnp.arange(math.prod(int(s) for s in shape), dtype=dtype)
     return fn(idx).reshape(shape)
 
 

@@ -126,7 +126,7 @@ def cached_by_id(cache: dict, obj, compute, bound: int = 256):
 
 def _max_source_id(ids) -> int:
     """max(ids) — a build-time constant, memoized per id-array object."""
-    return cached_by_id(_max_id_cache, ids, lambda: int(jnp.max(ids)))
+    return cached_by_id(_max_id_cache, ids, lambda: int(jnp.max(ids)))  # jaxlint: disable=JX01 build-time constant, memoized per id-array object; never on the search path
 
 
 def check_filter_covers_ids(keep, ids):

@@ -287,7 +287,7 @@ def _bitmap_max_exclusions(filter_obj, keep):
     from ._packing import cached_by_id
 
     def compute():
-        return int(jnp.sum(jnp.any(keep, axis=0))
+        return int(jnp.sum(jnp.any(keep, axis=0))  # jaxlint: disable=JX01 build-time constant, memoized per mask object; under tracing the ConcretizationTypeError path returns None
                    - jnp.min(jnp.sum(keep, axis=1)))
 
     try:

@@ -95,7 +95,7 @@ class IvfFlatIndex:
 
     @property
     def size(self) -> int:
-        return int(jnp.sum(self.counts))
+        return int(jnp.sum(self.counts))  # jaxlint: disable=JX01 size is a host-facing API scalar, not on the search path
 
 
 def build(dataset, params: Optional[IvfFlatIndexParams] = None, *,
@@ -214,7 +214,7 @@ def extend(index: IvfFlatIndex, new_vectors, new_ids=None) -> IvfFlatIndex:
     labels = jnp.argmin(sq_l2(x, index.centroids), axis=1).astype(jnp.int32)
     added = jax.ops.segment_sum(
         jnp.ones_like(labels), labels, num_segments=index.n_lists)
-    new_cap = max(index.list_cap, int(jnp.max(index.counts + added)))
+    new_cap = max(index.list_cap, int(jnp.max(index.counts + added)))  # jaxlint: disable=JX01 slab capacity must be a host int at extend time (static shapes)
 
     # pack the new rows into their own slab, then splice after the old rows
     (nd, nids), ncounts = pack_lists(

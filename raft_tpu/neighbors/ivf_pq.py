@@ -126,7 +126,7 @@ class IvfPqIndex:
 
     @property
     def size(self) -> int:
-        return int(jnp.sum(self.counts))
+        return int(jnp.sum(self.counts))  # jaxlint: disable=JX01 size is a host-facing API scalar, not on the search path
 
     def with_recon(self) -> "IvfPqIndex":
         """Return a copy with the derived reconstruction slab materialized
@@ -354,7 +354,7 @@ def extend(index: IvfPqIndex, new_vectors, new_ids=None) -> IvfPqIndex:
     labels = jnp.argmin(sq_l2(x, index.centroids), axis=1).astype(jnp.int32)
     added = jax.ops.segment_sum(jnp.ones_like(labels, jnp.int32), labels,
                                 num_segments=L)
-    new_cap = max(cap, int(jnp.max(index.counts + added)))
+    new_cap = max(cap, int(jnp.max(index.counts + added)))  # jaxlint: disable=JX01 slab capacity must be a host int at extend time (static shapes)
     pad = new_cap - cap
     codes = jnp.pad(index.codes, ((0, 0), (0, pad), (0, 0))) if pad else index.codes
     cnorms = jnp.pad(index.code_norms, ((0, 0), (0, pad))) if pad else index.code_norms
@@ -455,7 +455,6 @@ def build_chunked(dataset, params: Optional[IvfPqIndexParams] = None, *,
 def _search_recon_impl(centroids, recon, recon_norms, ids, q,
                        k: int, n_probes: int, metric: str, keep=None):
     nq, d = q.shape
-    cap = recon.shape[1]
     qf = q.astype(jnp.float32)
     qn = jnp.sum(qf * qf, axis=1)
     qb = q.astype(jnp.bfloat16)

@@ -275,8 +275,13 @@ class SearchServer:
                                                 qpad.dtype, level)
             with tracing.range("serve.dispatch(%s,b=%d,k=%d,lvl=%d)",
                                self.family, bucket, batch[0].k, level):
-                d, i = compiled(jnp.asarray(qpad), *operands)
-                d = np.asarray(d)   # host fetch = completion barrier
+                # explicit transfers at the serving boundary: device_put /
+                # device_get pass ``jax.transfer_guard("disallow")``, so a
+                # TraceGuard-wrapped serve loop proves these are the ONLY
+                # host<->device crossings on the path
+                d, i = compiled(jax.device_put(qpad), *operands)
+                d, i = jax.device_get((d, i))  # host fetch = completion barrier
+                d = np.asarray(d)
                 i = np.asarray(i)
         except Exception as exc:  # noqa: BLE001 — fail the batch, not the server
             for req in batch:

@@ -49,7 +49,7 @@ def _compact(coo: COO, keep: jax.Array) -> COO:
     rows = jnp.where(keep[order], coo.rows[order], coo.shape[0])
     cols = jnp.where(keep[order], coo.cols[order], coo.shape[1])
     vals = jnp.where(keep[order], coo.vals[order], 0)
-    n_kept = int(jnp.sum(keep))  # host sync: mirrors the reference's
+    n_kept = int(jnp.sum(keep))  # jaxlint: disable=JX01 mirrors the reference's cudaMemcpy of the compacted count (detail/coo.cuh coo_remove_scalar)
     # cudaMemcpy of the compacted count (detail/coo.cuh coo_remove_scalar)
     return COO(rows, cols, vals, coo.shape, n_kept)
 

@@ -32,7 +32,7 @@ def csr_select_k(
     Rows shorter than ``k`` are padded with ±inf values and ``-1`` indices
     (the reference's bounds contract for ``select_k``).
     """
-    width = int(jnp.max(csr.row_lengths())) if csr.n_rows else 0
+    width = int(jnp.max(csr.row_lengths())) if csr.n_rows else 0  # jaxlint: disable=JX01 static pad width sizes the dense gather; must be a host int
     width = max(width, 1)
     pad = jnp.inf if select_min else -jnp.inf
 

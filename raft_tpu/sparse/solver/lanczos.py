@@ -177,16 +177,28 @@ def lanczos_compute_eigenpairs(
         evals, evecs = jnp.linalg.eigh(t)
         return Vn, alpha, beta, u, evals, evecs
 
+    @jax.jit
+    def select_cycle(evals, evecs, beta_last):
+        """Ritz selection + restart residual fused on-device: one
+        executable instead of an eager argsort/gather/norm chain, and the
+        residual stays on-device until the convergence check's single
+        scalar sync (the former per-restart ``float(norm(...))`` forced a
+        full dispatch+sync every iteration)."""
+        sel = _select_ritz(evals, config.which, k)
+        ritz_vals = evals[sel]
+        s = evecs[:, sel]  # [ncv, k]
+        res = jnp.linalg.norm(beta_last * s[-1, :])
+        return ritz_vals, s, res
+
     key = jax.random.PRNGKey(config.seed + 1)
     V, alpha, beta, u, evals, evecs = first_cycle(v0, key)
     iters = ncv
     cycle = 0
     while True:
-        sel = _select_ritz(evals, config.which, k)
-        ritz_vals = evals[sel]
-        s = evecs[:, sel]  # [ncv, k]
-        res = float(jnp.linalg.norm(beta[ncv - 1] * s[ncv - 1, :]))
-        if res <= config.tolerance or iters >= config.max_iterations:
+        ritz_vals, s, res = select_cycle(evals, evecs, beta[ncv - 1])
+        # outer thick-restart loop runs on the host like the reference
+        # (lanczos_smallest:402): exactly one scalar sync per check
+        if float(res) <= config.tolerance or iters >= config.max_iterations:  # jaxlint: disable=JX01 host convergence check: one scalar sync per restart, the loop bound itself is host state
             break
         cycle += 1
         V, alpha, beta, u, evals, evecs = restart_cycle(

@@ -111,7 +111,7 @@ def mst(g: Union[COO, CSR]) -> MstResult:
         color = _pointer_jump(parent)[color]
 
     # compact picked edges (dedup (a,b)/(b,a): keep src<dst orientation once)
-    picked_np = np.asarray(picked)
+    picked_np = np.asarray(picked)  # jaxlint: disable=JX01 one-time host compaction of the final forest after the device rounds (output is host-built)
     src_np, dst_np, w_np = np.asarray(src), np.asarray(dst), np.asarray(w)
     lo = np.minimum(src_np, dst_np)
     hi = np.maximum(src_np, dst_np)

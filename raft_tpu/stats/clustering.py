@@ -10,6 +10,7 @@ All are formulated over the contingency matrix (one scatter-add) + reductions.
 from __future__ import annotations
 
 import enum
+import math
 from typing import Optional
 
 import jax
@@ -59,7 +60,7 @@ def entropy(labels, n_classes: Optional[int] = None):
     """Shannon entropy of a label set, in nats (``entropy.cuh``)."""
     y = wrap_array(labels, ndim=1).astype(jnp.int32)
     if n_classes is None:
-        n_classes = int(jnp.max(y)) + 1
+        n_classes = int(jnp.max(y)) + 1  # jaxlint: disable=JX01 output sizing needs a concrete bound; pass n_classes to stay async
     counts = jnp.zeros((n_classes,), jnp.float32).at[y].add(1.0)
     p = counts / y.shape[0]
     return -jnp.sum(jnp.where(p > 0, p * jnp.log(p), 0.0))
@@ -133,7 +134,7 @@ def silhouette_score(x, labels, n_clusters: Optional[int] = None, batch_size: Op
     y = wrap_array(labels, ndim=1).astype(jnp.int32)
     n, dim = x.shape
     if n_clusters is None:
-        n_clusters = int(jnp.max(y)) + 1
+        n_clusters = int(jnp.max(y)) + 1  # jaxlint: disable=JX01 output sizing needs a concrete bound; pass n_clusters to stay async
     if cluster_reduce == "auto":
         # decide from where x actually lives when knowable (a CPU-pinned
         # run on a TPU host must not land in the k-scaled matmul regime);
@@ -224,5 +225,9 @@ def information_criterion_batched(log_likelihood, ic_type: IC_Type, n_params: in
     elif ic_type == IC_Type.AICc:
         penalty = 2.0 * n_params + 2.0 * n_params * (n_params + 1) / max(n_samples - n_params - 1, 1)
     else:
-        penalty = jnp.log(jnp.asarray(float(n_samples))) * n_params
+        # n_samples is a host int: log it on the host — the former
+        # jnp.log(jnp.asarray(float(n_samples))) dispatched a device op
+        # (and an h2d transfer) for a static scalar, and its weak-f32
+        # rounding of log(n) was pure loss
+        penalty = math.log(n_samples) * n_params
     return -2.0 * ll + penalty

@@ -59,7 +59,7 @@ def contingency_matrix(ground_truth, predicted, n_classes: Optional[int] = None)
     pr = wrap_array(predicted, ndim=1).astype(jnp.int32)
     expects(gt.shape == pr.shape, "label length mismatch")
     if n_classes is None:
-        n_classes = int(jnp.maximum(jnp.max(gt), jnp.max(pr))) + 1
+        n_classes = int(jnp.maximum(jnp.max(gt), jnp.max(pr))) + 1  # jaxlint: disable=JX01 output sizing needs a concrete bound; pass n_classes to stay async
     flat = gt * n_classes + pr
     counts = jnp.zeros((n_classes * n_classes,), jnp.int32).at[flat].add(1)
     return counts.reshape(n_classes, n_classes)

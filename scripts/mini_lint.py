@@ -13,7 +13,15 @@ surprise:
 * E711/E712 — ``== None`` / ``== True`` / ``== False`` comparisons
 * E722  — bare ``except:``
 * E741  — ambiguous variable names ``l``, ``O``, ``I`` (assign/arg targets)
+* F841  — local variable assigned but never used (simple assignments only)
 * W291/W293 + end-of-file — trailing whitespace, missing/extra final newline
+
+``--jax`` additionally runs the TPU-hazard analyzer
+(:mod:`raft_tpu.analysis.jaxlint` — JX01..JX05, see docs/jax_hygiene.md)
+over the same tree through the same reporting and exit-code contract;
+``--stats-json PATH`` dumps the analyzer census (rules fired, waivers,
+files scanned) as a JSON artifact.  The analyzer module is loaded by file
+path, so running the linter never imports jax.
 
 Exit 1 when findings exist.  ``--fix`` repairs the whitespace class only
 (the code classes deserve human eyes).
@@ -59,6 +67,45 @@ def _exported(tree: ast.AST) -> set:
                     if isinstance(elt, ast.Constant) and isinstance(
                             elt.value, str):
                         out.add(elt.value)
+    return out
+
+
+def _f841_unused_locals(tree: ast.AST):
+    """F841: locals assigned (simple single-``Name`` targets) and never
+    loaded anywhere in the function subtree.  Tuple unpacking, attribute/
+    subscript targets, augmented/annotated assigns, ``for``/``with``
+    targets, underscore-prefixed names, and ``global``/``nonlocal`` names
+    are all left alone — those are either intentional or another rule's
+    business."""
+    out = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        loaded, escaped = set(), set()
+        assigns = {}  # name -> first assign lineno
+        # loads anywhere in the subtree count (a closure reading an outer
+        # local is a use), but assigns are scope-confined — a nested def's
+        # own locals belong to its visit, not its enclosing function's
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and isinstance(
+                    node.ctx, (ast.Load, ast.Del)):
+                loaded.add(node.id)  # an explicit ``del x`` is a reference
+            elif isinstance(node, (ast.Global, ast.Nonlocal)):
+                escaped.update(node.names)
+        stack = list(fn.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+                continue
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and not t.id.startswith("_"):
+                        assigns.setdefault(t.id, node.lineno)
+            stack.extend(ast.iter_child_nodes(node))
+        for name, lineno in sorted(assigns.items(), key=lambda kv: kv[1]):
+            if name not in loaded and name not in escaped:
+                out.append((lineno, name))
     return out
 
 
@@ -118,6 +165,10 @@ def check_file(path: str, fix: bool = False):
                 findings.append((path, node.lineno, "E741",
                                  f"ambiguous variable name {ident!r}"))
 
+    for lineno, name in _f841_unused_locals(tree):
+        findings.append((path, lineno, "F841",
+                         f"local variable {name!r} assigned but never used"))
+
     # whitespace hooks
     lines = src.split("\n")
     dirty = False
@@ -139,18 +190,66 @@ def check_file(path: str, fix: bool = False):
     return findings
 
 
-def main() -> int:
-    fix = "--fix" in sys.argv
-    root = next((a for a in sys.argv[1:] if not a.startswith("-")), ".")
+def _load_jaxlint():
+    """Load the analyzer module by file path — never imports raft_tpu (and
+    therefore never imports jax): the linter must run on a bare host."""
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    mod_path = os.path.join(repo, "raft_tpu", "analysis", "jaxlint.py")
+    spec = importlib.util.spec_from_file_location("jaxlint", mod_path)
+    module = importlib.util.module_from_spec(spec)
+    sys.modules["jaxlint"] = module  # dataclasses needs the module registered
+    spec.loader.exec_module(module)
+    return module
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    fix = "--fix" in argv
+    jax_pass = "--jax" in argv
+    stats_path = None
+    if "--stats-json" in argv:
+        stats_path = argv[argv.index("--stats-json") + 1]
+    skip_next = False
+    root = "."
+    for a in argv:
+        if skip_next:
+            skip_next = False
+            continue
+        if a == "--stats-json":
+            skip_next = True
+        elif not a.startswith("-"):
+            root = a
+            break
     all_findings = []
     n = 0
     for path in sorted(py_files(root)):
         n += 1
         all_findings.extend(check_file(path, fix=fix))
+
+    jax_note = ""
+    if jax_pass:
+        jaxlint = _load_jaxlint()
+        rep = jaxlint.scan_tree(root)
+        for f in rep.findings:
+            all_findings.append((f.path, f.line, f.code, f.msg))
+        jax_note = (f"; jaxlint: {rep.files} files, "
+                    f"{len(rep.findings)} active, {len(rep.waived)} waived")
+        if stats_path:
+            import json
+
+            os.makedirs(os.path.dirname(stats_path) or ".", exist_ok=True)
+            with open(stats_path, "w", encoding="utf-8") as fh:
+                json.dump(rep.stats(), fh, indent=2, sort_keys=True)
+                fh.write("\n")
+            jax_note += f"; stats -> {stats_path}"
+
     for path, line, code, msg in all_findings:
         print(f"{path}:{line}: {code} {msg}")
     print(f"mini-lint: {n} files, {len(all_findings)} finding(s)"
-          f"{' (whitespace auto-fixed)' if fix else ''}", file=sys.stderr)
+          f"{' (whitespace auto-fixed)' if fix else ''}{jax_note}",
+          file=sys.stderr)
     return 1 if all_findings else 0
 
 

@@ -61,6 +61,9 @@ run_step() {  # name, timeout_s, command...   (two attempts, gated .done)
   done
 }
 
+# jaxlint first: pure-host AST pass, ~seconds, zero chip time — a hazard
+# (hidden sync, retrace loop, f64 leak) must never cost TPU minutes to find
+run_step jaxlint        300 python scripts/mini_lint.py --jax raft_tpu --stats-json bench/JAXLINT.json
 run_step mosaic         900 env RAFT_MOSAIC_REQUIRE_TPU=1 python scripts/mosaic_check.py
 run_step bench         4500 python bench.py
 # the checkpoints exist to survive a wedge WITHIN a bench run; once the

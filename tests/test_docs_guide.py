@@ -30,3 +30,7 @@ def test_tuning_guide_snippets_execute():
 
 def test_serving_guide_snippets_execute():
     _run_guide("serving_guide.md", min_blocks=2)
+
+
+def test_jax_hygiene_snippets_execute():
+    _run_guide("jax_hygiene.md", min_blocks=6)

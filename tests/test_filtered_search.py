@@ -61,7 +61,6 @@ class TestFilteredIvf:
     def test_ivf_pq_filter_excludes(self, fdata):
         x, q, keep, gt = fdata
         idx = ivf_pq.build(x, ivf_pq.IvfPqIndexParams(n_lists=16, pq_dim=12))
-        sp = ivf_pq.IvfPqSearchParams(n_probes=16)
         for mode in ("recon", "lut"):
             sp2 = ivf_pq.IvfPqSearchParams(n_probes=16, mode=mode)
             _, ids = ivf_pq.search(idx, q, 10, sp2, filter=keep)

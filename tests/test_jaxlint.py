@@ -1,0 +1,287 @@
+"""raft_tpu.analysis.jaxlint — seeded-violation fixtures + tree gate.
+
+Three layers:
+
+* one fixture per rule proving it FIRES on a minimal violation and goes
+  quiet when the hazard is written the blessed way (the good/bad pairs
+  mirror ``docs/jax_hygiene.md``);
+* the waiver contract: a ``# jaxlint: disable=<CODE> reason`` comment
+  waives exactly that code on that line, a bare ``disable=`` is itself a
+  finding (JXW0), and waivers carry their reason into the report;
+* the tier-1 tree gate: ``raft_tpu/`` scans to **zero unwaived
+  findings**, and every waiver in the tree has a written reason — the
+  same contract ``python scripts/mini_lint.py --jax raft_tpu`` enforces
+  in CI.
+
+jaxlint itself is pure stdlib — ``scripts/mini_lint.py`` loads it by
+file path so linting never imports jax (the package import here goes
+through ``raft_tpu/__init__``, which does).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from raft_tpu.analysis import ALL_RULES, scan_source, scan_tree
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def codes(findings, only_active=True):
+    return [f.code for f in findings if not (only_active and f.waived)]
+
+
+def scan(src, rel="raft_tpu/somelib.py"):
+    """Scan a snippet as if it lived in library (non-exempt) code."""
+    return scan_source(src, rel, rel)
+
+
+# ---------------------------------------------------------------------------
+# JX01 — host sync in library code
+
+
+def test_jx01_fires_on_sync_sinks():
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "def f(x):\n"
+        "    r = jnp.sum(x)\n"
+        "    a = float(r)\n"
+        "    b = r.item()\n"
+        "    c = np.asarray(r)\n"
+        "    d = jax.device_get(r)\n"
+        "    return a, b, c, d\n")
+    assert codes(scan(src)) == ["JX01"] * 4
+
+
+def test_jx01_quiet_on_device_and_static_values():
+    src = (
+        "import jax.numpy as jnp\n"
+        "def f(x, cfg):\n"
+        "    r = jnp.sum(x)                  # stays on device\n"
+        "    n = int(x.shape[0])             # static metadata, not traced\n"
+        "    lim = float(cfg.tolerance)      # plain host value\n"
+        "    return jnp.where(r > lim, r, 0.0), n\n")
+    assert codes(scan(src)) == []
+
+
+def test_jx01_exempt_at_host_boundary():
+    src = ("import jax.numpy as jnp\n"
+           "def fetch(x):\n"
+           "    return float(jnp.sum(x))\n")
+    assert codes(scan(src, rel="raft_tpu/serve/server.py")) == []
+    assert codes(scan(src, rel="raft_tpu/io/reader.py")) == []
+    assert codes(scan(src, rel="tests/test_thing.py")) == []
+    assert codes(scan(src, rel="raft_tpu/stats/metrics.py")) == ["JX01"]
+
+
+# ---------------------------------------------------------------------------
+# JX02 — recompilation hazards
+
+
+def test_jx02_fires_on_traced_branch_inside_jit():
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    s = jnp.sum(x)\n"
+        "    if s > 0:\n"
+        "        return x\n"
+        "    return -x\n")
+    assert codes(scan(src)) == ["JX02"]
+
+
+def test_jx02_quiet_on_lax_cond_and_static_branch():
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "@jax.jit\n"
+        "def f(x, flag=None):\n"
+        "    if flag is None:                # `is None` is static dispatch\n"
+        "        return x\n"
+        "    return jax.lax.cond(jnp.sum(x) > 0, lambda v: v,\n"
+        "                        lambda v: -v, x)\n")
+    assert codes(scan(src)) == []
+
+
+def test_jx02_fires_on_jit_per_call_and_jit_in_loop():
+    src = (
+        "import jax\n"
+        "def f(xs, g):\n"
+        "    out = jax.jit(g)(xs[0])\n"
+        "    fns = []\n"
+        "    for _ in range(3):\n"
+        "        fns.append(jax.jit(g))\n"
+        "    return out, fns\n")
+    assert codes(scan(src)) == ["JX02", "JX02"]
+
+
+def test_jx02_quiet_on_def_site_jit():
+    src = ("import jax\n"
+           "@jax.jit\n"
+           "def g(x):\n"
+           "    return x + 1\n"
+           "def f(xs):\n"
+           "    return [g(x) for x in xs]\n")
+    assert codes(scan(src)) == []
+
+
+# ---------------------------------------------------------------------------
+# JX03 — float64 leaks
+
+
+def test_jx03_fires_on_float64_request():
+    src = ("import jax.numpy as jnp\n"
+           "import numpy as np\n"
+           "def f(x):\n"
+           "    return jnp.zeros((4,), jnp.float64) + np.float64(0)\n")
+    assert codes(scan(src)) == ["JX03", "JX03"]
+
+
+def test_jx03_quiet_under_x64_gate_and_on_f32():
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "def f(x):\n"
+        "    if jax.config.jax_enable_x64:\n"
+        "        acc = jnp.float64\n"
+        "    else:\n"
+        "        acc = jnp.float32\n"
+        "    return jnp.zeros((4,), acc), jnp.ones((4,), jnp.float32)\n")
+    assert codes(scan(src)) == []
+
+
+# ---------------------------------------------------------------------------
+# JX04 — impure host calls inside jit
+
+
+def test_jx04_fires_on_np_random_and_time_inside_jit():
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "import time\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    noise = np.random.normal(size=4)\n"
+        "    t = time.perf_counter()\n"
+        "    return x + noise, t\n")
+    assert codes(scan(src)) == ["JX04", "JX04"]
+
+
+def test_jx04_quiet_outside_jit_and_with_jax_random():
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "def make_data():\n"
+        "    return np.random.normal(size=4)   # host-side setup: fine\n"
+        "@jax.jit\n"
+        "def f(x, key):\n"
+        "    return x + jax.random.normal(key, (4,))\n")
+    assert codes(scan(src)) == []
+
+
+# ---------------------------------------------------------------------------
+# JX05 — completion barriers in library code
+
+
+def test_jx05_fires_in_library_quiet_in_serve_bench():
+    src = ("def f(x):\n"
+           "    return x.block_until_ready()\n")
+    assert codes(scan(src)) == ["JX05"]
+    assert codes(scan(src, rel="raft_tpu/serve/server.py")) == []
+    assert codes(scan(src, rel="bench/serve.py")) == []
+    assert codes(scan(src, rel="scripts/driver.py")) == []
+
+
+# ---------------------------------------------------------------------------
+# waiver contract
+
+
+@pytest.mark.parametrize("code,bad_line", [
+    ("JX01", "    return float(jnp.sum(x))"),
+    ("JX05", "    return x.block_until_ready()"),
+])
+def test_waiver_silences_exactly_its_code(code, bad_line):
+    src = "import jax.numpy as jnp\ndef f(x):\n" + bad_line + "\n"
+    assert codes(scan(src)) == [code]
+    waived = src.replace(
+        bad_line, bad_line + f"  # jaxlint: disable={code} measured, one"
+        " sync per call is the contract")
+    out = scan(waived)
+    assert codes(out) == []
+    w = [f for f in out if f.waived]
+    assert len(w) == 1 and w[0].code == code
+    assert "measured" in w[0].reason
+    # the waiver names a DIFFERENT code: the finding stays active
+    wrong = src.replace(bad_line,
+                        bad_line + "  # jaxlint: disable=JX03 mismatched")
+    assert codes(scan(wrong)) == [code]
+
+
+def test_waiver_on_multiline_statement_end_line():
+    src = ("import jax.numpy as jnp\n"
+           "def f(x):\n"
+           "    return float(jnp.sum(x)\n"
+           "                 + 1.0)  # jaxlint: disable=JX01 spans lines\n")
+    out = scan(src)
+    assert codes(out) == []
+    assert [f.code for f in out if f.waived] == ["JX01"]
+
+
+def test_bare_waiver_is_jxw0():
+    src = ("import jax.numpy as jnp\n"
+           "def f(x):\n"
+           "    return float(jnp.sum(x))  # jaxlint: disable=JX01\n")
+    out = scan(src)
+    assert codes(out) == ["JXW0"]  # the JX01 itself is waived...
+    assert [f.code for f in out if f.waived] == ["JX01"]
+    # ...but the reasonless waiver is an unwaivable finding of its own
+
+
+def test_syntax_error_reports_jx99():
+    out = scan("def broken(:\n")
+    assert [f.code for f in out] == ["JX99"]
+
+
+# ---------------------------------------------------------------------------
+# the tree gate
+
+
+def test_rule_catalog_is_complete():
+    assert set(ALL_RULES) == {"JX01", "JX02", "JX03", "JX04", "JX05", "JXW0"}
+
+
+def test_tree_scan_zero_unwaived_and_reasons_written():
+    rep = scan_tree(os.path.join(REPO, "raft_tpu"))
+    assert rep.files > 100
+    assert rep.findings == [], [
+        f"{f.path}:{f.line} {f.code} {f.msg}" for f in rep.findings]
+    for f in rep.waived:
+        assert f.reason, f"bare waiver at {f.path}:{f.line}"
+    stats = rep.stats()
+    assert stats["unwaived_findings"] == 0
+    assert stats["waiver_total"] == len(rep.waived)
+    assert stats["rule_catalog"] == ALL_RULES
+
+
+def test_mini_lint_jax_entry_point_exits_zero(tmp_path):
+    """The CI command: one lint entry point, one exit-code contract, and
+    the stats artifact lands where bench/JAXLINT.json is committed from."""
+    stats = tmp_path / "JAXLINT.json"
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "mini_lint.py"),
+         "--jax", os.path.join(REPO, "raft_tpu"),
+         "--stats-json", str(stats)],
+        capture_output=True, text=True, timeout=300)
+    assert p.returncode == 0, p.stdout + p.stderr
+    blob = json.loads(stats.read_text())
+    assert blob["tool"] == "jaxlint"
+    assert blob["unwaived_findings"] == 0
+    assert blob["files_scanned"] > 100

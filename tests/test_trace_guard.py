@@ -1,0 +1,161 @@
+"""raft_tpu.core.trace_guard — runtime steady-state gates.
+
+Two layers:
+
+* unit tests for the :class:`TraceGuard` counters themselves (a cold
+  jit call must register, a warm one must not, nesting composes);
+* the hot-path regression gates this harness exists for — after warmup,
+  the serve dispatch loop and every index family's ``search()`` must run
+  with **zero jit cache misses and zero implicit host<->device
+  transfers** (``jax.transfer_guard("disallow")`` raises on any implicit
+  transfer even on CPU; the trace/compile census is backend-independent).
+
+Operands are placed on device *before* entering a guard: creating an
+array inside the region is itself an implicit transfer, and catching
+exactly that class of accident is the point of the gate.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.core import SteadyStateError, TraceGuard
+from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq
+from raft_tpu.serve import SearchServer, ServerConfig
+
+# ---------------------------------------------------------------------------
+# TraceGuard unit behavior
+
+
+def test_cold_call_counts_trace_and_compile():
+    f = jax.jit(lambda x: x * 3 + 1)
+    x = jnp.ones((8,))
+    with TraceGuard() as tg:
+        f(x).block_until_ready()
+    assert tg.traces >= 1
+    assert tg.compiles >= 1
+    with pytest.raises(SteadyStateError, match="not steady-state"):
+        tg.assert_steady_state()
+
+
+def test_warm_call_is_silent():
+    f = jax.jit(lambda x: x - 2)
+    x = jnp.ones((8,))
+    f(x)  # warm outside the guard
+    with TraceGuard() as tg:
+        for _ in range(16):
+            f(x)
+    assert (tg.traces, tg.compiles) == (0, 0)
+    tg.assert_steady_state()  # must not raise
+
+
+def test_budgeted_assertion():
+    f = jax.jit(lambda x: x / 7)
+    x = jnp.ones((8,))
+    with TraceGuard() as tg:
+        f(x)
+    tg.assert_steady_state(max_traces=tg.traces, max_compiles=tg.compiles)
+    with pytest.raises(SteadyStateError):
+        tg.assert_steady_state(max_traces=tg.traces - 1,
+                               max_compiles=tg.compiles)
+
+
+def test_nested_guards_both_observe():
+    f = jax.jit(lambda x: x + 11)
+    x = jnp.ones((8,))
+    with TraceGuard() as outer:
+        with TraceGuard() as inner:
+            f(x)
+    assert outer.traces == inner.traces >= 1
+    assert outer.compiles == inner.compiles >= 1
+
+
+def test_guard_reports_transfer_violations():
+    # creating an array from a Python constant inside the region is an
+    # implicit host->device transfer: "disallow" must raise even on CPU
+    with pytest.raises(Exception, match="[Dd]isallow"):
+        with TraceGuard():
+            jnp.ones((4,)).block_until_ready()
+    # the same region under "allow" is fine (counters still run)
+    with TraceGuard(transfer="allow") as tg:
+        jnp.ones((4,)).block_until_ready()
+    assert tg.transfer == "allow"
+
+
+# ---------------------------------------------------------------------------
+# hot-path gates
+
+N, D, K = 192, 16, 4
+
+
+@pytest.fixture(scope="module")
+def db():
+    return np.random.default_rng(7).standard_normal((N, D)).astype(np.float32)
+
+
+def test_server_steady_state_200_mixed_requests(db):
+    """The acceptance gate: after warmup, 200 mixed-shape requests through
+    the serve loop with zero traces, zero compiles, zero implicit
+    transfers — the AOT ladder plus explicit device_put/device_get must
+    cover the entire dispatch path."""
+    ladder = (1, 8, 64)
+    srv = SearchServer(db, k=K, config=ServerConfig(ladder=ladder))
+    assert srv.warmup() == len(ladder)
+    rng = np.random.default_rng(11)
+    requests = [rng.standard_normal((int(rng.integers(1, 40)), D))
+                .astype(np.float32) for _ in range(200)]
+    futs = []
+    with TraceGuard() as tg:
+        for q in requests:
+            futs.append((q, srv.submit(q)))
+            while len(srv._pending) >= 32:
+                srv.step()
+        while srv.step():
+            pass
+    tg.assert_steady_state()
+    assert srv.cache.compiles == len(ladder)  # warmup only
+    for q, fut in futs:
+        d, i = fut.result(timeout=0)
+        assert i.shape == (q.shape[0], K)
+    assert srv.metrics.completed == 200
+
+
+@pytest.fixture(scope="module")
+def family_searches(db):
+    """(description, zero-arg warm-callable) per family; queries live on
+    device before any guard is entered."""
+    q = jax.device_put(np.random.default_rng(8)
+                       .standard_normal((7, D)).astype(np.float32))
+    dbd = jax.device_put(db)
+    fi = ivf_flat.build(db, ivf_flat.IvfFlatIndexParams(n_lists=6))
+    fp = ivf_flat.IvfFlatSearchParams(n_probes=3)
+    pi = ivf_pq.build(db, ivf_pq.IvfPqIndexParams(n_lists=6, pq_dim=8,
+                                                  pq_bits=4))
+    pp = ivf_pq.IvfPqSearchParams(n_probes=3)
+    ci = cagra.build(db, cagra.CagraIndexParams(graph_degree=8))
+    cp = cagra.CagraSearchParams(itopk_size=16)
+    return {
+        "brute_force": lambda: brute_force.knn(q, dbd, k=K),
+        "ivf_flat": lambda: ivf_flat.search(fi, q, K, params=fp),
+        "ivf_pq": lambda: ivf_pq.search(pi, q, K, params=pp),
+        "cagra": lambda: cagra.search(ci, q, K, params=cp),
+    }
+
+
+@pytest.mark.parametrize("family", ["brute_force", "ivf_flat", "ivf_pq",
+                                    "cagra"])
+def test_family_search_steady_state(family_searches, family):
+    """Repeated ``search()`` on a warm index: zero jit cache misses and
+    clean under ``transfer_guard("disallow")`` for every family."""
+    search = family_searches[family]
+    d, i = search()  # warm: first call may trace/compile freely
+    jax.block_until_ready((d, i))
+    with TraceGuard() as tg:
+        for _ in range(3):
+            d2, i2 = search()
+        jax.block_until_ready((d2, i2))
+    tg.assert_steady_state()
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(i2))
