@@ -1,0 +1,235 @@
+"""Offline probe_block tuning — pick the probe-block size B per
+``(family, n_probes, list cap)`` bucket by measurement, the same
+trained-heuristic pattern as ``bench/tune_select_k.py``.
+
+Blocked and per-probe scans return bit-identical results (pinned by
+``tests/test_probe_block.py``), so this tuner compares pure wall-clock —
+no recall gate.  Run on the target backend (real TPU for production
+numbers):
+
+    python bench/tune_probe_block.py [--quick] [--cpu]
+
+Writes ``raft_tpu/neighbors/_probe_block_table.json`` keyed by
+``family:n_probes.bit_length():cap.bit_length()`` —
+``resolve_probe_block``'s ``probe_block=0`` (auto) consults it at call
+time; absent entries fall back to the candidates-per-merge heuristic.
+Also writes the probe-bound A/B acceptance artifact
+``bench/PROBE_BLOCK_<BACKEND>.json`` (per-probe vs blocked wall-clock at
+the highest-probe config of the grid).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+# persistent XLA executable cache (shared with bench.py): repeat runs
+# on the same machine skip recompilation
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.expanduser("~/.cache/raft_tpu_jax"))
+
+import jax
+
+from _platform import pin_backend
+
+# MUST precede any backend use (see tune_select_k.py: the axon plugin's
+# sitecustomize overrides a bare JAX_PLATFORMS env var)
+pin_backend(sys.argv)
+
+import numpy as np
+
+from _timing import timeit as _time
+from raft_tpu.neighbors import ivf_flat, ivf_pq
+from raft_tpu.neighbors._packing import resolve_probe_block
+
+ROWS, DIM, NQ, K = 120_000, 64, 256, 10
+BLOCK_CANDIDATES = [1, 2, 4, 8, 16, 32]
+# (n_lists, n_probes grid): spans cap buckets ~2800 (32 lists) down to
+# ~350 (512 lists), and the shortlist-bound -> probe-bound probe range
+CONFIGS = [(512, [8, 16, 64]), (128, [8, 16, 64]), (32, [8, 16])]
+QUICK_CONFIGS = [(512, [16, 64]), (128, [64])]
+
+
+def bucket_key(family: str, n_probes: int, cap: int) -> str:
+    """Must mirror ``resolve_probe_block``'s table key scheme exactly."""
+    return f"{family}:{n_probes.bit_length()}:{cap.bit_length()}"
+
+
+def kernel_sha() -> str:
+    """Hash of the scan + merge sources the timings depend on — recorded
+    in the sidecar (stale-table detection) and scoping the resume
+    checkpoint."""
+    import hashlib
+
+    root = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+    h = hashlib.sha256()
+    for rel in ("raft_tpu/neighbors/ivf_flat.py",
+                "raft_tpu/neighbors/ivf_pq.py",
+                "raft_tpu/neighbors/_packing.py",
+                "raft_tpu/neighbors/brute_force.py",
+                "raft_tpu/matrix/select_k.py"):
+        with open(os.path.join(root, rel), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def _build_indexes(n_lists: int, x):
+    fi = ivf_flat.build(x, ivf_flat.IvfFlatIndexParams(
+        n_lists=n_lists, list_cap_ratio=1.5, kmeans_trainset_fraction=0.05,
+        seed=0))
+    pi = ivf_pq.build(x, ivf_pq.IvfPqIndexParams(
+        n_lists=n_lists, pq_dim=16, list_cap_ratio=1.5,
+        kmeans_trainset_fraction=0.05, seed=0))
+    return {"ivf_flat": fi, "ivf_pq": pi}
+
+
+def _searcher(family: str, index, q, n_probes: int, pb: int):
+    if family == "ivf_flat":
+        p = ivf_flat.IvfFlatSearchParams(n_probes=n_probes, probe_block=pb)
+        return lambda: ivf_flat.search(index, q, K, p)
+    p = ivf_pq.IvfPqSearchParams(n_probes=n_probes, mode="lut",
+                                 probe_block=pb)
+    return lambda: ivf_pq.search(index, q, K, p)
+
+
+def main() -> None:
+    quick = "--quick" in sys.argv
+    configs = QUICK_CONFIGS if quick else CONFIGS
+    sha = kernel_sha()
+    backend = jax.default_backend()
+
+    # resume checkpoint: decided buckets flush immediately and a re-run
+    # under the SAME backend + kernel sources skips them (tunnel-wedge
+    # recovery, same story as tune_select_k.py)
+    ckpt_path = os.path.join(
+        "/tmp", f"tune_probe_block.{backend}.u{os.getuid()}.partial.json")
+    table: dict = {}
+    timings: dict = {}
+    try:
+        with open(ckpt_path) as f:
+            prior = json.load(f)
+        if prior.get("backend") == backend and prior.get("kernel_sha") == sha:
+            table = prior.get("table", {})
+            timings = prior.get("timings", {})
+            print(f"resuming: {len(table)} buckets from checkpoint",
+                  file=sys.stderr)
+    except (OSError, ValueError):
+        pass
+
+    warned = []
+
+    def flush_ckpt():
+        try:
+            with open(ckpt_path + ".tmp", "w") as f:
+                json.dump({"backend": backend, "kernel_sha": sha,
+                           "table": table, "timings": timings}, f)
+            os.replace(ckpt_path + ".tmp", ckpt_path)
+        except OSError as e:
+            if not warned:
+                warned.append(True)
+                print(f"WARN: checkpoint flush failing ({e}); a mid-run "
+                      f"kill will lose progress", file=sys.stderr)
+
+    rng = np.random.default_rng(0)
+    x = (rng.standard_normal((ROWS, DIM))
+         + 3 * rng.standard_normal((256, DIM))[rng.integers(0, 256, ROWS)]
+         ).astype(np.float32)
+    q = jax.device_put(x[:NQ] + 0.1)
+
+    # resume identity is (family, n_lists, n_probes) — the bucket key
+    # alone can't gate the build loop since cap is unknown until built
+    decided = {(k.split(":")[0], t["n_lists"], t["n_probes"])
+               for k, t in timings.items()}
+    for n_lists, probe_grid in configs:
+        if all((family, n_lists, p) in decided
+               for family in ("ivf_flat", "ivf_pq") for p in probe_grid):
+            continue
+        indexes = _build_indexes(n_lists, x)
+        for family, index in indexes.items():
+            cap = index.list_cap
+            for n_probes in probe_grid:
+                key = bucket_key(family, n_probes, cap)
+                if (family, n_lists, n_probes) in decided:
+                    continue
+                best_b, best_t, curve = None, float("inf"), {}
+                for pb in BLOCK_CANDIDATES:
+                    if pb > n_probes:
+                        continue
+                    t = _time(_searcher(family, index, q, n_probes, pb))
+                    curve[str(pb)] = t
+                    if t < best_t:
+                        best_b, best_t = pb, t
+                table[key] = best_b
+                timings[key] = {"n_lists": n_lists, "cap": cap,
+                                "n_probes": n_probes, "curve_s": curve}
+                flush_ckpt()
+                print(f"{family:9s} n_lists={n_lists:4d} cap={cap:5d} "
+                      f"p={n_probes:3d} → B={best_b} "
+                      f"({best_t * 1e3:.1f} ms; B=1 "
+                      f"{curve.get('1', float('nan')) * 1e3:.1f} ms)")
+
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                       "raft_tpu", "neighbors", "_probe_block_table.json")
+    if backend != "tpu" and "--force" not in sys.argv:
+        # an off-TPU run must never clobber the table the TPU search
+        # paths consult (same rule as the select_k tuner)
+        out = out.replace(".json", f".{backend}.json")
+        print(f"non-TPU backend: writing to {os.path.basename(out)} "
+              f"(--force overrides)", file=sys.stderr)
+    with open(out, "w") as f:
+        json.dump(table, f, indent=1, sort_keys=True)
+
+    import datetime
+
+    with open(out.replace(".json", ".meta.json"), "w") as f:
+        json.dump({"backend": backend,
+                   "date": datetime.date.today().isoformat(),
+                   "kernel_sha": sha,
+                   "n_entries": len(table)}, f)
+        f.write("\n")
+
+    # probe-bound A/B acceptance artifact: per-probe vs blocked at the
+    # highest-probe bucket measured (>= 64 probes unless --quick trimmed
+    # the grid) — the headline "blocked beats per-probe" number
+    ab = {}
+    for key, t in timings.items():
+        family = key.split(":")[0]
+        p = t["n_probes"]
+        if p < max(pg for _, g in configs for pg in g):
+            continue
+        curve = t["curve_s"]
+        best_b = str(table[key])
+        if "1" in curve and best_b in curve:
+            ab[key] = {
+                "n_lists": t["n_lists"], "cap": t["cap"], "n_probes": p,
+                "nq": NQ, "k": K, "rows": ROWS, "dim": DIM,
+                "per_probe_s": curve["1"],
+                "blocked_s": curve[best_b], "probe_block": table[key],
+                "speedup": curve["1"] / curve[best_b],
+                "curve_s": curve,
+            }
+    ab_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           f"PROBE_BLOCK_{backend.upper()}.json")
+    with open(ab_path, "w") as f:
+        json.dump({"backend": backend, "kernel_sha": sha,
+                   "note": "per-probe (B=1) vs blocked wall-clock at the "
+                           "probe-bound grid point; bit-identical results "
+                           "by construction (tests/test_probe_block.py)",
+                   "configs": ab}, f, indent=1, sort_keys=True)
+        f.write("\n")
+    try:
+        os.remove(ckpt_path)  # spent: the final table supersedes it
+    except OSError:
+        pass
+    print(f"wrote {len(table)} entries → {os.path.normpath(out)}")
+    print(f"A/B artifact → {os.path.normpath(ab_path)}")
+    # the auto path must be able to see what we just measured
+    r = resolve_probe_block(0, 64, 512, "ivf_flat")
+    assert r >= 1
+
+
+if __name__ == "__main__":
+    main()

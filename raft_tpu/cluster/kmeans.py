@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..core.array import wrap_array
+from ..core.compat import shard_map
 from ..core.errors import expects
 from ..distance.fused import _fused_l2_nn
 from ..distance.pairwise import sq_l2
@@ -248,7 +249,7 @@ def _sharded_fit_program(mesh: Mesh, axis: str, k: int, max_iter: int, tol: floa
         return c, inertia, it
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             fit, mesh=mesh, in_specs=(P(axis), P()), out_specs=(P(), P(), P()),
             check_vma=False,
         )

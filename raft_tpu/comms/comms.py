@@ -37,9 +37,10 @@ from typing import Callable, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..core.compat import axis_size, shard_map
 from ..core.errors import expects
 
 __all__ = [
@@ -255,7 +256,7 @@ def barrier(*, axis: str):
 
 def _static_axis_size(axis: str) -> int:
     try:
-        return lax.axis_size(axis)  # available in tracing context
+        return axis_size(axis)  # available in tracing context
     except Exception:
         raise ValueError(f"axis {axis!r} not bound; call inside shard_map") from None
 

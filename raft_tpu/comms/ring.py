@@ -21,6 +21,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from ..core.compat import axis_size
+
 __all__ = ["ring_topk_merge"]
 
 
@@ -34,7 +36,7 @@ def ring_topk_merge(vals: jax.Array, idx: jax.Array, k: int, axis: str,
     order-independent).  ``vals`` must be min-ordered when ``select_min``
     (negate beforehand otherwise).
     """
-    size = jax.lax.axis_size(axis)
+    size = axis_size(axis)
     perm = [(j, (j + 1) % size) for j in range(size)]
 
     def hop(carry, _):

@@ -1,0 +1,32 @@
+"""Version-compat aliases for JAX APIs that moved between releases.
+
+The library targets the current public surface (``jax.shard_map`` with
+``check_vma``); older runtimes still in the fleet carry it under
+``jax.experimental.shard_map`` with the ``check_rep`` spelling.  Call
+sites import the alias from here instead of branching per-version.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["axis_size", "shard_map"]
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # pre-0.6 runtimes: experimental namespace
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        # the old kwarg is check_rep; semantics (disable the replication
+        # checker) are the same for every use in this tree
+        return _legacy_shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_rep=check_vma)
+
+try:
+    axis_size = jax.lax.axis_size
+except AttributeError:  # pre-0.5: the size hangs off the axis env
+    def axis_size(axis):
+        from jax.core import axis_frame
+
+        return axis_frame(axis)  # returns the mapped axis size (an int)

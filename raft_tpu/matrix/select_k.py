@@ -124,9 +124,13 @@ def select_k(
     ``in_idx`` optionally maps positions to caller-provided indices.
     Returns ``(out_val, out_idx)`` of shape ``(batch, k)``.
 
-    ``sorted=False`` relaxes the output-order contract as in the reference;
-    the TPU implementations happen to always produce sorted output (a valid
-    refinement), so the flag currently changes nothing.
+    ``sorted=False`` relaxes the output-order contract as in the reference:
+    the returned (value, index) pairs are the exact top-k *set*, in
+    unspecified row order.  The ``kSortFull``/``kBinSelect`` paths then skip
+    their final ordering (``argpartition`` instead of a ranked sort/top_k);
+    ``kTopK``/``kPartialBitonic`` still emit sorted output, which is a valid
+    refinement of the relaxed contract.  Intermediate ``tile_knn_merge``
+    carries use the unsorted form — only a scan's final merge needs order.
     """
     in_val = wrap_array(in_val, ndim=2)
     batch, length = in_val.shape
@@ -158,13 +162,18 @@ def select_k(
         else:
             vals, idx = jax.lax.top_k(in_val, k_eff)
     elif algo == SelectAlgo.kSortFull:
-        order = jnp.argsort(in_val if select_min else -in_val, axis=1)[:, :k_eff]
+        signed = in_val if select_min else -in_val
+        if sorted:
+            order = jnp.argsort(signed, axis=1)[:, :k_eff]
+        else:  # exact top-k set, order unspecified: partition, don't rank
+            order = jnp.argpartition(signed, k_eff - 1, axis=1)[:, :k_eff]
         vals = jnp.take_along_axis(in_val, order, axis=1)
         idx = order
     elif algo == SelectAlgo.kBinSelect:
         from ..ops.bin_select import bin_select_k
 
-        vals, idx = bin_select_k(in_val, k_eff, select_min=select_min)
+        vals, idx = bin_select_k(in_val, k_eff, select_min=select_min,
+                                 sorted=sorted)
 
     if in_idx is not None:
         in_idx = wrap_array(in_idx, ndim=2)
@@ -172,7 +181,14 @@ def select_k(
     idx = idx.astype(jnp.int32) if in_idx is None else idx
 
     if k_eff < k:  # pad to requested k like the reference's bounds contract
-        pad_val = jnp.full((batch, k - k_eff), jnp.inf if select_min else -jnp.inf, in_val.dtype)
+        if jnp.issubdtype(in_val.dtype, jnp.integer):
+            # jnp.full(..., inf, int_dtype) raises — pad with the dtype's
+            # own never-selected extreme instead
+            info = jnp.iinfo(in_val.dtype)
+            fill = info.max if select_min else info.min
+        else:
+            fill = jnp.inf if select_min else -jnp.inf
+        pad_val = jnp.full((batch, k - k_eff), fill, in_val.dtype)
         pad_idx = jnp.full((batch, k - k_eff), -1, idx.dtype)
         vals = jnp.concatenate([vals, pad_val], axis=1)
         idx = jnp.concatenate([idx, pad_idx], axis=1)

@@ -14,7 +14,7 @@ replacement for CUDA atomic-append list construction.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Tuple
 
 import jax
@@ -23,7 +23,8 @@ import jax.numpy as jnp
 __all__ = ["pack_lists", "chunked_queries", "chunked_filtered_queries",
            "check_filter_covers_ids", "keep_lookup", "scatter_append",
            "scatter_append_copy", "shard_rows", "sharded_train_sizes",
-           "as_keep_mask", "sentinel_filtered_ids", "prefetch_chunks"]
+           "as_keep_mask", "sentinel_filtered_ids", "prefetch_chunks",
+           "blocked_probe_plan", "resolve_probe_block"]
 
 
 def prefetch_chunks(dataset, chunk_rows: int, ids=None):
@@ -183,6 +184,72 @@ def keep_lookup(keep, vids):
     vc = jnp.maximum(vids, 0)
     return keep[vc] if keep.ndim == 1 \
         else jnp.take_along_axis(keep, vc, axis=1)
+
+
+def blocked_probe_plan(probes, block: int):
+    """Reshape a ``(nq, P)`` probe table into per-step scan inputs for a
+    probe-blocked search: ``block`` probes are gathered, scored, and merged
+    per ``lax.scan`` step instead of one (⌈P/B⌉ top-k merges instead of P).
+
+    P is padded up to a multiple of ``block`` with a *validity* row — never
+    duplicate probes, which would insert the same candidates into the
+    running top-k twice.  Pad positions must contribute dist = +inf.
+
+    Returns ``(lists_xs, probe_valid_xs)`` of shapes ``[n_blocks, nq, B]``
+    and ``[n_blocks, B]`` (both scan xs).
+    """
+    nq, n_probes = probes.shape
+    pad = (-n_probes) % block
+    if pad:
+        probes = jnp.pad(probes, ((0, 0), (0, pad)))
+    pvalid = (jnp.arange(n_probes + pad) < n_probes).reshape(-1, block)
+    lists_xs = jnp.moveaxis(probes.reshape(nq, -1, block), 1, 0)
+    return lists_xs, pvalid
+
+
+@lru_cache(maxsize=1)
+def _probe_block_table():
+    """Measured probe_block table written by ``bench/tune_probe_block.py``
+    (same offline-tuned-dispatch pattern as ``matrix/_select_k_table.json``).
+    Canonical name first; a ``.{backend}.json`` suffix holds off-TPU
+    measurements without clobbering the TPU table."""
+    import json
+    import os
+
+    base = os.path.join(os.path.dirname(__file__), "_probe_block_table")
+    for suffix in (".json", f".{jax.default_backend()}.json"):
+        try:
+            with open(base + suffix) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            continue
+    return {}
+
+
+_probe_block_cache: dict = {}
+
+
+def resolve_probe_block(requested: int, n_probes: int, cap: int,
+                        family: str) -> int:
+    """Static probe-block width for an IVF search config.
+
+    ``requested > 0`` wins (clamped to ``[1, n_probes]``); ``0`` = auto:
+    the measured table (log2-bucketed like ``select_k``'s dispatch table),
+    else a heuristic bounding both the merge width and the per-step gather
+    working set.  Pure host-int arithmetic — never touches the device."""
+    if requested:
+        return max(1, min(int(requested), max(1, n_probes)))
+    key = f"{family}:{n_probes.bit_length()}:{cap.bit_length()}"
+    hit = _probe_block_cache.get(key)
+    if hit is None:
+        entry = _probe_block_table().get(key)
+        if entry is None:
+            # bound the [nq, B*cap] slab + merge width: ~16k candidates
+            # per step, at most 8 probes, never more than n_probes
+            entry = min(max(1, n_probes), 8, max(1, 16384 // max(cap, 1)))
+        hit = _probe_block_cache[key] = max(1, min(int(entry),
+                                                  max(1, n_probes)))
+    return hit
 
 
 def sentinel_filtered_ids(vals, ids):

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..core.array import wrap_array
+from ..core.compat import shard_map
 from ..core.errors import expects
 
 __all__ = ["knn", "knn_sharded", "searcher", "tile_knn_merge"]
@@ -72,14 +73,19 @@ def _tile_distances(x, yt, metric: str, xn=None):
     return _metric_from_dots(dots, xn, yn[None, :], metric)
 
 
-def tile_knn_merge(best_val, best_idx, tile_val, tile_idx, k: int):
+def tile_knn_merge(best_val, best_idx, tile_val, tile_idx, k: int, *,
+                   sorted: bool = True):
     """Merge a new candidate block into the running (m, k) best buffers via
-    ``matrix.select_k`` — one selection primitive owns all top-k tuning."""
+    ``matrix.select_k`` — one selection primitive owns all top-k tuning.
+
+    ``sorted=False`` keeps the carry an unordered top-k set (exact values
+    and ids, unspecified row order) — the right form for intermediate scan
+    carries, where only the FINAL merge needs ranked output."""
     from ..matrix.select_k import select_k
 
     vals = jnp.concatenate([best_val, tile_val], axis=1)
     idxs = jnp.concatenate([best_idx, tile_idx], axis=1)
-    return select_k(vals, k, in_idx=idxs, select_min=True)
+    return select_k(vals, k, in_idx=idxs, select_min=True, sorted=sorted)
 
 
 @partial(jax.jit, static_argnames=("k", "metric", "tile"))
@@ -116,7 +122,8 @@ def _knn_impl(x, y, k: int, metric: str, tile: int,
         dist = jnp.where(valid, dist, jnp.inf)
         neg, loc = jax.lax.top_k(-dist, kk)
         tv, ti = -neg, t * tile + loc
-        return tile_knn_merge(best_val, best_idx, tv, ti, k), None
+        return tile_knn_merge(best_val, best_idx, tv, ti, k,
+                              sorted=False), None
 
     init = (
         jnp.full((m, k), jnp.inf, jnp.float32),
@@ -126,6 +133,10 @@ def _knn_impl(x, y, k: int, metric: str, tile: int,
         step, init,
         (jnp.arange(ytiles.shape[0], dtype=jnp.int32), ytiles, keep_xs),
     )
+    # intermediate carries are unordered top-k sets; rank once at the end
+    from ..matrix.select_k import select_k
+
+    bv, bi = select_k(bv, k, in_idx=bi, select_min=True)
     if metric == "inner_product":
         bv = -bv  # undo the similarity negation
     return bv, bi
@@ -466,7 +477,7 @@ def _sharded_knn_program(mesh: Mesh, axis: str, rows: int, k: int, kk: int,
              else P(axis) if keep_ndim == 1
              else P(data_axis, axis))
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             local,
             mesh=mesh,
             in_specs=(qspec, P(axis), kspec),

@@ -37,6 +37,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..core.array import wrap_array
+from ..core.compat import shard_map
 from ..core.errors import expects
 from ..matrix.select_k import select_k
 from ..utils.segment import within_group_rank as _within_group_rank
@@ -578,7 +579,7 @@ def _sharded_build_program(mesh: Mesh, axis: str, per: int, kk: int,
         return (x_l[None], graph[None], c[None],
                 nodes.astype(jnp.int32)[None])
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         local, mesh=mesh, in_specs=P(axis), out_specs=(P(axis),) * 4,
         check_vma=False,
     ))
@@ -655,7 +656,7 @@ def _sharded_search_program(mesh: Mesh, axis: str, data_axis: Optional[str],
     # keep masks GLOBAL ids → replicated over the shard axis; bitmap rows
     # follow the query partitioning
     kspec = (P(data_axis) if (keep_ndim == 2 and data_axis) else P())
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         local, mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P(axis), qspec, P(), kspec),
         out_specs=(qspec, qspec),

@@ -12,10 +12,14 @@ TPU-first design:
   source ids, pad entries masked by per-list counts.  Gathers of whole lists
   are contiguous HBM reads; no pointer-chasing.
 * **Search**: query→centroid distances on the MXU, ``top_k`` probe pick,
-  then one scan iteration per probe rank: gather the probed list slab,
-  batched dot on the MXU, mask pads, merge into the running top-k via
-  ``select_k`` (same merge primitive as brute force).  Everything
-  static-shape, jit-compiled once per (nq, k, n_probes) config.
+  then one scan iteration per **probe block** of B probe ranks: one
+  ``[nq, B·cap, d]`` slab gather, one batched MXU dot, pads masked, ONE
+  merge into the running top-k via ``select_k`` (same merge primitive as
+  brute force) — ⌈n_probes/B⌉ merges instead of n_probes, with unsorted
+  intermediate carries and a single ranked selection after the scan.
+  Everything static-shape, jit-compiled once per
+  (nq, k, n_probes, probe_block) config; B defaults from the measured
+  ``_probe_block_table`` (``bench/tune_probe_block.py``).
 * **Sharded variant**: lists are partitioned round-robin over the mesh axis;
   every shard searches its local lists with the same program and the
   per-shard candidates merge with one ``all_gather`` + ``select_k`` -- the
@@ -35,6 +39,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..cluster.kmeans import KMeansParams, capped_assign, kmeans_balanced_fit
 from ..core.array import wrap_array
+from ..core.compat import shard_map
 from ..core.errors import expects
 from ..distance.pairwise import sq_l2
 from .brute_force import tile_knn_merge
@@ -69,6 +74,11 @@ class IvfFlatIndexParams:
 class IvfFlatSearchParams:
     n_probes: int = 32
     query_chunk: int = 4096  # cap on the [chunk, cap, d] gather working set
+    # probes gathered+scored+merged per scan step; 0 = auto (measured
+    # table via bench/tune_probe_block.py, else a working-set heuristic).
+    # Results are bit-identical for every value — this is a pure
+    # latency/throughput knob (docs/tuning_guide.md).
+    probe_block: int = 0
 
 
 @jax.tree_util.register_dataclass
@@ -243,54 +253,75 @@ def extend(index: IvfFlatIndex, new_vectors, new_ids=None) -> IvfFlatIndex:
 
 
 def _probe_scan(q, qn, data, ids, counts, norms, probes, k: int, metric: str,
-                keep=None):
-    """Scan probe ranks, merging each probed list into the running top-k.
+                keep=None, probe_block: int = 1):
+    """Scan probe *blocks*, merging each gathered block into the running
+    top-k.
 
-    q: [nq, d]; probes: [nq, P].  One iteration gathers the p-th probed list
-    of every query ([nq, cap, d] slab) and computes the distance block with a
-    batched MXU dot.  ``keep``: optional (n,) bool prefilter by source id.
+    q: [nq, d]; probes: [nq, P].  One iteration gathers the next B probed
+    lists of every query (one ``[nq, B·cap, d]`` slab), computes the
+    distance block with one batched MXU dot and folds it in with ONE
+    ``tile_knn_merge`` — ⌈P/B⌉ merges instead of P.  Per-candidate math is
+    independent of B, so results are bit-identical across block sizes; pad
+    probes (P not divisible by B) are masked to +inf, never duplicated.
+    Intermediate carries stay unordered (``sorted=False``); callers rank
+    once after the scan.  ``keep``: optional bool prefilter by source id.
     """
+    from ._packing import blocked_probe_plan, exact_gathered_dots
+
     nq = q.shape[0]
     cap = data.shape[1]
-    n_probes = probes.shape[1]
+    lists_xs, pvalid = blocked_probe_plan(probes, probe_block)
 
-    def step(carry, p):
+    def step(carry, inp):
         best_val, best_idx = carry
-        lists = probes[:, p]                      # [nq]
-        vecs = data[lists]                        # [nq, cap, d]
-        vids = ids[lists]                         # [nq, cap]
-        from ._packing import exact_gathered_dots
-
-        dots = exact_gathered_dots("qcd,qd->qc", vecs, q)
+        lists, pv = inp                           # [nq, B], [B]
+        B = lists.shape[1]
+        bcap = B * cap
+        vecs = data[lists]                        # [nq, B, cap, d] gather
+        vids = ids[lists].reshape(nq, bcap)       # [nq, B·cap]
+        # B stays in the einsum's *batch* dims: the inner [cap, d]·[d]
+        # contraction shape — hence f32 accumulation order — is then
+        # identical for every probe_block.  Folding B into the N dimension
+        # retiles the reduction and breaks blocked == per-probe bit parity.
+        dots = exact_gathered_dots(
+            "qbcd,qbd->qbc", vecs,
+            jnp.broadcast_to(q[:, None, :], (nq, B, q.shape[1])),
+        ).reshape(nq, bcap)
         if metric == "inner_product":
             dist = -dots
         else:  # sqeuclidean / euclidean rank by squared L2
-            dist = norms[lists] - 2.0 * dots + qn[:, None]
+            dist = norms[lists].reshape(nq, bcap) - 2.0 * dots + qn[:, None]
             dist = jnp.maximum(dist, 0.0)
-        valid = jnp.arange(cap)[None, :] < counts[lists][:, None]
-        valid = valid & (vids >= 0)
+        valid = (jnp.arange(cap)[None, None, :]
+                 < counts[lists][:, :, None]).reshape(nq, bcap)
+        valid = valid & (vids >= 0) & jnp.repeat(pv, cap)[None, :]
         if keep is not None:
             from ._packing import keep_lookup
 
             valid = valid & keep_lookup(keep, vids)
         dist = jnp.where(valid, dist, jnp.inf)
-        return tile_knn_merge(best_val, best_idx, dist, vids, k), None
+        return tile_knn_merge(best_val, best_idx, dist, vids, k,
+                              sorted=False), None
 
     init = (jnp.full((nq, k), jnp.inf, jnp.float32),
             jnp.full((nq, k), -1, jnp.int32))
-    (bv, bi), _ = jax.lax.scan(step, init, jnp.arange(n_probes))
-    return bv, bi
+    (bv, bi), _ = jax.lax.scan(step, init, (lists_xs, pvalid))
+    # one ranked selection over the unordered carry — the only sorted merge
+    from ..matrix.select_k import select_k
+
+    return select_k(bv, k, in_idx=bi, select_min=True)
 
 
-@partial(jax.jit, static_argnames=("k", "n_probes", "metric"))
+@partial(jax.jit, static_argnames=("k", "n_probes", "metric", "probe_block"))
 def _search_impl(centroids, data, ids, counts, norms, q, k: int,
-                 n_probes: int, metric: str, keep=None):
+                 n_probes: int, metric: str, keep=None,
+                 probe_block: int = 1):
     qf = q.astype(jnp.float32)
     qn = jnp.sum(qf * qf, axis=1)
     cd = sq_l2(q, centroids)                      # [nq, L] MXU block
     _, probes = jax.lax.top_k(-cd, n_probes)      # nearest lists
     bv, bi = _probe_scan(q, qn, data, ids, counts, norms, probes, k, metric,
-                         keep)
+                         keep, probe_block)
     if metric == "euclidean":
         bv = jnp.sqrt(jnp.maximum(bv, 0.0))
     elif metric == "inner_product":
@@ -308,20 +339,23 @@ def search(index: IvfFlatIndex, queries, k: int,
     bitset filter) or a per-query ``core.Bitmap``/(nq, n) bools (bitmap
     filter)."""
     from ._packing import (as_keep_mask, check_filter_covers_ids,
-                           chunked_filtered_queries,
+                           chunked_filtered_queries, resolve_probe_block,
                            sentinel_filtered_ids)
 
     p = params or IvfFlatSearchParams()
     q = wrap_array(queries, ndim=2, name="queries")
     expects(q.shape[1] == index.dim, "query dim mismatch")
     n_probes = min(p.n_probes, index.n_lists)
+    probe_block = resolve_probe_block(p.probe_block, int(n_probes),
+                                      index.list_cap, "ivf_flat")
     keep = as_keep_mask(filter, nq=q.shape[0])  # indexes source ids
     if keep is not None:
         check_filter_covers_ids(keep, index.ids)
 
     impl = lambda qc, kc: _search_impl(
         index.centroids, index.data, index.ids, index.counts,
-        index.norms, qc, int(k), int(n_probes), index.metric, kc)
+        index.norms, qc, int(k), int(n_probes), index.metric, kc,
+        probe_block)
     dv, di = chunked_filtered_queries(impl, q, int(p.query_chunk), keep)
     if keep is not None:  # sub-k survivors: sentinel tail, not real ids
         di = sentinel_filtered_ids(dv, di)
@@ -338,14 +372,18 @@ def searcher(index: IvfFlatIndex, k: int,
     ``jax.jit(fn).lower(q_spec, *operands).compile()``; the index slabs
     ride as operands so bucket executables share them instead of baking
     per-bucket constants."""
+    from ._packing import resolve_probe_block
+
     p = params or IvfFlatSearchParams()
     expects(k >= 1, "k must be >= 1")
     n_probes = int(min(p.n_probes, index.n_lists))
+    probe_block = resolve_probe_block(p.probe_block, n_probes,
+                                      index.list_cap, "ivf_flat")
     metric = index.metric
 
     def fn(q, centroids, data, ids, counts, norms):
         return _search_impl(centroids, data, ids, counts, norms, q,
-                            int(k), n_probes, metric, None)
+                            int(k), n_probes, metric, None, probe_block)
 
     return fn, (index.centroids, index.data, index.ids, index.counts,
                 index.norms)
@@ -388,7 +426,7 @@ def _sharded_build_program(mesh: Mesh, axis: str, n_orig: int, per: int,
         # rounding to uint8 would quantize the probe routing)
         return c, data, out_ids, counts, norms
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         local, mesh=mesh, in_specs=P(axis),
         out_specs=(P(axis),) * 5, check_vma=False,
     ))
@@ -421,13 +459,14 @@ def build_sharded(dataset, mesh: Mesh, params: Optional[IvfFlatIndexParams] = No
 
 
 @partial(jax.jit, static_argnames=("k", "n_probes", "metric", "axis", "mesh",
-                                   "data_axis"))
+                                   "data_axis", "probe_block"))
 def _search_sharded_impl(mesh, axis, centroids, data, ids, counts, norms, q,
                          k: int, n_probes: int, metric: str,
-                         data_axis: Optional[str] = None, keep=None):
+                         data_axis: Optional[str] = None, keep=None,
+                         probe_block: int = 1):
     def local(centroids_l, data_l, ids_l, counts_l, norms_l, q_l, keep_l):
         bv, bi = _search_impl(centroids_l, data_l, ids_l, counts_l, norms_l,
-                              q_l, k, n_probes, metric, keep_l)
+                              q_l, k, n_probes, metric, keep_l, probe_block)
         # candidates from all shards → final top-k everywhere
         if metric == "inner_product":
             bv = -bv  # back to min-selectable
@@ -447,7 +486,7 @@ def _search_sharded_impl(mesh, axis, centroids, data, ids, counts, norms, q,
     # axis; a 2-D bitmap's query rows follow the query partitioning
     kspec = (P(data_axis) if (keep is not None and keep.ndim == 2
                               and data_axis) else P())
-    return jax.shard_map(
+    return shard_map(
         local,
         mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), qspec, kspec),
@@ -473,13 +512,15 @@ def search_sharded(index: IvfFlatIndex, queries, k: int,
     contract as :func:`search` (replicated over the shard axis).
     """
     from ._packing import (as_keep_mask, check_filter_covers_ids,
-                           sentinel_filtered_ids)
+                           resolve_probe_block, sentinel_filtered_ids)
 
     p = params or IvfFlatSearchParams()
     q = wrap_array(queries, ndim=2, name="queries")
     n_dev = int(mesh.shape[axis])
     local_lists = index.n_lists // n_dev
     n_probes = min(p.n_probes, local_lists)
+    probe_block = resolve_probe_block(p.probe_block, int(n_probes),
+                                      index.list_cap, "ivf_flat")
     if data_axis is not None:
         expects(data_axis in mesh.axis_names, f"axis {data_axis!r} not in mesh")
         expects(q.shape[0] % int(mesh.shape[data_axis]) == 0,
@@ -490,7 +531,7 @@ def search_sharded(index: IvfFlatIndex, queries, k: int,
     dv, di = _search_sharded_impl(mesh, axis, index.centroids, index.data,
                                   index.ids, index.counts, index.norms, q,
                                   int(k), int(n_probes), index.metric,
-                                  data_axis, keep)
+                                  data_axis, keep, probe_block)
     if keep is not None:
         di = sentinel_filtered_ids(dv, di)
     return dv, di

@@ -16,11 +16,25 @@ configs (``BASELINE.json``: ivf_pq on DEEP-10M) and standard IVF-PQ
     bf16 contraction, the shape TPUs are built for.  The slab is
     *derived* state: it is rebuilt from the codes on load and never
     serialized, so the persisted index stays PQ-compressed.
-  - ``mode="lut"``: classic ADC from the uint8 codes via per-query lookup
-    tables (the einsum LUT + gather path).  4× less HBM gather traffic
-    per candidate than recon at pq_dim = d/2·…, but the table gather is
+  - ``mode="lut"``: classic ADC from the uint8 codes via lookup tables,
+    with the table algebra split so NOTHING query×probe-dependent is
+    recomputed inside the probe loop:
+    ``⟨q−c, r̂⟩ = ⟨q, r̂⟩ − ⟨c, r̂⟩`` — the probe-invariant query LUT
+    ``⟨q, codebooks⟩`` is one einsum per query chunk *outside* the scan,
+    and the query-invariant centroid cross term is precomputed at build
+    time (``centroid_lut`` ``[L, m, c]`` f32, ~8 MB at typical shapes)
+    and folded per slot into ``adc_norms = ‖r̂‖² + 2⟨c, r̂⟩`` (the
+    FAISS precomputed-tables identity).  The per-probe work is then just
+    a code gather + table lookup.  4× less HBM gather traffic per
+    candidate than recon at pq_dim = d/2·…, but the table gather is
     VPU-bound on TPU; use it when HBM capacity, not speed, binds (the
     slab is 2·d bytes/vector vs pq_dim bytes/vector).
+
+* **Probe blocking**: both tiers scan ``probe_block`` probes per step —
+  one ``[nq, B·cap]`` slab gather, one fused distance block, ONE top-k
+  merge per block (unsorted carries, a single ranked selection after the
+  scan).  Results are bit-identical for every block size; B defaults from
+  the measured ``_probe_block_table`` (``bench/tune_probe_block.py``).
 
 * Lists reuse the IVF-Flat padded-slab layout (device-packed via
   :mod:`._packing`); optional exact re-ranking lives in
@@ -39,6 +53,7 @@ import numpy as np
 
 from ..cluster.kmeans import KMeansParams, capped_assign, kmeans_balanced_fit
 from ..core.array import wrap_array
+from ..core.compat import shard_map
 from ..core.errors import expects
 from ..distance.pairwise import sq_l2
 from ._packing import chunked_filtered_queries, pack_lists
@@ -83,6 +98,10 @@ class IvfPqSearchParams:
     n_probes: int = 32
     mode: str = "auto"       # auto | recon | lut
     query_chunk: int = 4096  # cap on [chunk, cap, d] gather working set
+    # probes gathered+scored+merged per scan step; 0 = auto (measured
+    # table via bench/tune_probe_block.py, else a working-set heuristic).
+    # Bit-identical results at every value — a pure speed knob.
+    probe_block: int = 0
 
 
 @jax.tree_util.register_dataclass
@@ -102,9 +121,17 @@ class IvfPqIndex:
     # byte, [L, cap, ceil(m/2)] — half the HBM/disk of byte codes
     packed: bool = dataclasses.field(default=False,
                                      metadata=dict(static=True))
+    # Hoisted-ADC tier (derived like recon — never serialized, rebuilt on
+    # load via with_adc_luts(), so old artifacts round-trip unchanged):
+    # ⟨c_list, codebooks⟩ per subspace entry, and the per-slot adjusted
+    # norm ‖r̂‖² + 2⟨c_list, r̂⟩ that absorbs the centroid cross term of
+    # ⟨q−c, r̂⟩ = ⟨q, r̂⟩ − ⟨c, r̂⟩ (FAISS precomputed-tables identity)
+    centroid_lut: Optional[jax.Array] = None  # [L, m, c] f32
+    adc_norms: Optional[jax.Array] = None     # [L, cap] f32
 
     # save_index skips these; load_index restores them via with_recon()
-    _derived_fields = ("recon", "recon_norms")
+    # and with_adc_luts()
+    _derived_fields = ("recon", "recon_norms", "centroid_lut", "adc_norms")
 
     @property
     def n_lists(self) -> int:
@@ -143,6 +170,22 @@ class IvfPqIndex:
         if self.recon is None:
             return self
         return dataclasses.replace(self, recon=None, recon_norms=None)
+
+    def with_adc_luts(self) -> "IvfPqIndex":
+        """Return a copy with the hoisted-ADC tables materialized
+        (idempotent): ``centroid_lut`` [L, m, c] and ``adc_norms``
+        [L, cap].  Derived state like the recon slab — rebuilt after
+        :func:`load_index`, never serialized.  ``search(mode="lut")``
+        computes them on the fly when absent; materializing once here
+        amortizes that across calls.  Valid for packed and unpacked
+        codes alike (``adc_norms`` depends on code *values*, which
+        packing preserves)."""
+        if self.centroid_lut is not None and self.adc_norms is not None:
+            return self
+        clut, anorms = _adc_tables(self.codes, self.centroids,
+                                   self.codebooks, self.code_norms)
+        return dataclasses.replace(self, centroid_lut=clut,
+                                   adc_norms=anorms)
 
     def with_packed_codes(self) -> "IvfPqIndex":
         """4-bit packing: two sub-codes per byte (requires ``pq_bits ≤ 4``
@@ -268,6 +311,49 @@ def _decode_slab(codes, centroids, codebooks, ids):
     return (rec.reshape(-1, cap, d)[:L], norms.reshape(-1, cap)[:L])
 
 
+@jax.jit
+def _adc_tables(codes, centroids, codebooks, code_norms):
+    """Build the hoisted-ADC tables: ``centroid_lut[l, m, c] =
+    ⟨centroid_l restricted to subspace m, codebook entry c⟩`` and the
+    per-slot adjusted norms ``adc_norms[l, j] = ‖r̂_{l,j}‖² +
+    2·Σ_m centroid_lut[l, m, codes[l, j, m]]``.
+
+    With these, LUT-mode ADC needs only the probe-invariant query LUT:
+    ``‖q−c−r̂‖² = ‖q−c‖² − 2⟨q, r̂⟩ + adc_norms`` — no per-probe einsum.
+    Chunked over list blocks (lax.map) so the [block, m, cap] gather
+    intermediate stays bounded, mirroring :func:`_decode_slab`.
+    """
+    L, cap, mc = codes.shape
+    m, c, ds = codebooks.shape
+    clut = jnp.einsum(
+        "lms,mcs->lmc",
+        centroids.astype(jnp.float32).reshape(L, m, ds),
+        codebooks.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    block = max(1, min(L, max(1, (1 << 24) // max(cap * m, 1))))
+    pad = (-L) % block
+    codes_p = jnp.pad(codes, ((0, pad), (0, 0), (0, 0)))
+    clut_p = jnp.pad(clut, ((0, pad), (0, 0), (0, 0)))
+    norms_p = jnp.pad(code_norms, ((0, pad), (0, 0)))
+
+    def cross_block(args):
+        cb_codes, cb_clut, cb_norms = args
+        if mc != m:  # 4-bit packed storage: unpack one block at a time
+            cb_codes = _unpack_codes4(cb_codes, m)
+        g = jnp.take_along_axis(
+            cb_clut, cb_codes.astype(jnp.int32).transpose(0, 2, 1), axis=2)
+        return cb_norms + 2.0 * jnp.sum(g, axis=1)
+
+    anorms = jax.lax.map(
+        cross_block,
+        (codes_p.reshape(-1, block, cap, mc),
+         clut_p.reshape(-1, block, m, c),
+         norms_p.reshape(-1, block, cap)),
+    )
+    return clut, anorms.reshape(-1, cap)[:L]
+
+
 def _pack_codes4(codes: jax.Array) -> jax.Array:
     """Pack 4-bit sub-codes pairwise: ``[..., m] → [..., ceil(m/2)]``
     (even positions in the low nibble).  Values must be < 16."""
@@ -325,6 +411,7 @@ def build(dataset, params: Optional[IvfPqIndexParams] = None, *,
 
     index = IvfPqIndex(centroids, codebooks, pk_codes, pk_norms, pk_ids,
                        counts, p.metric)
+    index = index.with_adc_luts()  # hoisted-ADC tables, while codes are unpacked
     index = index.with_recon() if p.store_recon else index
     return index.with_packed_codes() if p.pack_codes else index
 
@@ -370,6 +457,8 @@ def extend(index: IvfPqIndex, new_vectors, new_ids=None) -> IvfPqIndex:
         (ch_codes, ch_norms, ids), n_lists=L, cap=new_cap)
     out = IvfPqIndex(index.centroids, index.codebooks, codes, cnorms,
                      slab_ids, counts, index.metric)
+    if index.adc_norms is not None:  # list capacity may have grown: rebuild
+        out = out.with_adc_luts()
     return out.with_recon() if index.recon is not None else out
 
 
@@ -442,6 +531,7 @@ def build_chunked(dataset, params: Optional[IvfPqIndexParams] = None, *,
 
     index = IvfPqIndex(centroids, codebooks, codes, cnorms, ids_slab,
                        counts, p.metric)
+    index = index.with_adc_luts()  # hoisted-ADC tables, while codes are unpacked
     index = index.with_recon() if p.store_recon else index
     return index.with_packed_codes() if p.pack_codes else index
 
@@ -451,37 +541,58 @@ def build_chunked(dataset, params: Optional[IvfPqIndexParams] = None, *,
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnames=("k", "n_probes", "metric"))
+@partial(jax.jit, static_argnames=("k", "n_probes", "metric", "probe_block"))
 def _search_recon_impl(centroids, recon, recon_norms, ids, q,
-                       k: int, n_probes: int, metric: str, keep=None):
+                       k: int, n_probes: int, metric: str, keep=None,
+                       probe_block: int = 1):
+    from ._packing import blocked_probe_plan
+
     nq, d = q.shape
+    cap = recon.shape[1]
     qf = q.astype(jnp.float32)
     qn = jnp.sum(qf * qf, axis=1)
     qb = q.astype(jnp.bfloat16)
     cd = sq_l2(q, centroids)                      # [nq, L]
     _, probes = jax.lax.top_k(-cd, n_probes)
+    lists_xs, pvalid = blocked_probe_plan(probes, probe_block)
 
-    def step(carry, p):
+    def step(carry, inp):
         best_val, best_idx = carry
-        lists = probes[:, p]                      # [nq]
-        slab = recon[lists]                       # [nq, cap, d] bf16 gather
-        vids = ids[lists]
-        dots = jnp.einsum("qcd,qd->qc", slab, qb,
-                          preferred_element_type=jnp.float32)
+        lists, pv = inp                           # [nq, B], [B]
+        B = lists.shape[1]
+        bcap = B * cap
+        slab = recon[lists]                       # one [nq, B, cap, d] gather
+        vids = ids[lists].reshape(nq, bcap)
+        # keep B in the *batch* dims so the inner [cap, d]·[d] contraction
+        # — and with it the f32 accumulation order — is identical for every
+        # probe_block.  Folding B into the N dimension ("q(bc)d,qd") retiles
+        # the reduction and shifts last-ulp rounding, breaking the
+        # blocked == per-probe bit-parity contract.
+        dots = jnp.einsum(
+            "qbcd,qbd->qbc", slab,
+            jnp.broadcast_to(qb[:, None, :], (nq, B, d)),
+            preferred_element_type=jnp.float32).reshape(nq, bcap)
         if metric == "inner_product":
             dist = jnp.where(vids >= 0, -dots, jnp.inf)
         else:
             # recon_norms carries +inf on pad entries — they self-mask
-            dist = qn[:, None] - 2.0 * dots + recon_norms[lists]
+            dist = qn[:, None] - 2.0 * dots + recon_norms[lists].reshape(
+                nq, bcap)
+        # pad probes (n_probes % B != 0) contribute nothing
+        dist = jnp.where(jnp.repeat(pv, cap)[None, :], dist, jnp.inf)
         if keep is not None:  # prefilter by source id (True = keep)
             from ._packing import keep_lookup
 
             dist = jnp.where(keep_lookup(keep, vids), dist, jnp.inf)
-        return tile_knn_merge(best_val, best_idx, dist, vids, k), None
+        return tile_knn_merge(best_val, best_idx, dist, vids, k,
+                              sorted=False), None
 
     init = (jnp.full((nq, k), jnp.inf, jnp.float32),
             jnp.full((nq, k), -1, jnp.int32))
-    (bv, bi), _ = jax.lax.scan(step, init, jnp.arange(n_probes))
+    (bv, bi), _ = jax.lax.scan(step, init, (lists_xs, pvalid))
+    from ..matrix.select_k import select_k
+
+    bv, bi = select_k(bv, k, in_idx=bi, select_min=True)
     if metric == "euclidean":
         bv = jnp.sqrt(jnp.maximum(bv, 0.0))
     elif metric == "inner_product":
@@ -494,9 +605,21 @@ def _search_recon_impl(centroids, recon, recon_norms, ids, q,
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnames=("k", "n_probes", "metric"))
-def _search_lut_impl(centroids, codebooks, codes, code_norms, ids, counts, q,
-                     k: int, n_probes: int, metric: str, keep=None):
+@partial(jax.jit, static_argnames=("k", "n_probes", "metric", "probe_block"))
+def _search_lut_impl(centroids, codebooks, codes, adc_norms, ids, counts, q,
+                     k: int, n_probes: int, metric: str, keep=None,
+                     probe_block: int = 1):
+    """Hoisted-ADC scan: the probe loop does NO einsum.
+
+    ``⟨q−c, r̂⟩ = ⟨q, r̂⟩ − ⟨c, r̂⟩`` splits the classic residual LUT into
+    the probe-invariant query LUT (one einsum per query chunk, below) and
+    the query-invariant centroid cross term, pre-folded per slot into
+    ``adc_norms = ‖r̂‖² + 2⟨c, r̂⟩`` at build time (:func:`_adc_tables`).
+    Per probe block that leaves a code gather + table lookup:
+    ``‖q−c−r̂‖² = ‖q−c‖² − 2·Σ_m qlut[m, code_m] + adc_norms``.
+    """
+    from ._packing import blocked_probe_plan
+
     nq, d = q.shape
     m, c, ds = codebooks.shape
     cap = codes.shape[1]
@@ -504,54 +627,55 @@ def _search_lut_impl(centroids, codebooks, codes, code_norms, ids, counts, q,
     qf = q.astype(jnp.float32)
     cd = sq_l2(q, centroids)                      # [nq, L]
     _, probes = jax.lax.top_k(-cd, n_probes)
+    # probe-invariant query LUT ⟨q, codebooks⟩ — hoisted out of the scan
+    qlut = jnp.einsum("qms,mcs->qmc", qf.reshape(nq, m, ds), codebooks,
+                      preferred_element_type=jnp.float32)
+    if metric == "inner_product":
+        qc = qf @ centroids.T                     # [nq, L] ⟨q, c⟩, hoisted
+    lists_xs, pvalid = blocked_probe_plan(probes, probe_block)
 
-    def step(carry, p):
+    def step(carry, inp):
         best_val, best_idx = carry
-        lists = probes[:, p]                      # [nq]
-        # ADC: ‖q−c−r̂‖² = ‖q−c‖² − 2⟨qr, r̂⟩ + ‖r̂‖²
-        qr = qf - centroids[lists]                # [nq, d] residual queries
-        qr_sub = qr.reshape(nq, m, ds)
-        lut = jnp.einsum(
-            "qms,mcs->qmc", qr_sub, codebooks,
-            preferred_element_type=jnp.float32,
-        )                                          # [nq, m, c] inner products
-        lcodes = codes[lists]                      # [nq, cap, m or ceil(m/2)]
-        if lcodes.shape[-1] != m:                  # 4-bit packed storage:
-            lcodes = _unpack_codes4(lcodes, m)     # unpack AFTER the gather
-        lcodes = lcodes.astype(jnp.int32)
-        # gather: ip[nq, cap] = Σ_m lut[q, m, code[q, cap, m]]
+        lists, pv = inp                           # [nq, B], [B]
+        B = lists.shape[1]
+        bcap = B * cap
+        lcodes = codes[lists]                     # [nq, B, cap, m or ⌈m/2⌉]
+        if lcodes.shape[-1] != m:                 # 4-bit packed storage:
+            lcodes = _unpack_codes4(lcodes, m)    # unpack AFTER the gather
+        lcodes = lcodes.astype(jnp.int32).reshape(nq, bcap, m)
+        # lookup: ip[nq, B·cap] = Σ_m qlut[q, m, code[q, j, m]]
         ip = jnp.sum(
-            jnp.take_along_axis(lut, lcodes.transpose(0, 2, 1), axis=2),
+            jnp.take_along_axis(qlut, lcodes.transpose(0, 2, 1), axis=2),
             axis=1,
         )
-        qr_norm = jnp.take_along_axis(cd, lists[:, None], axis=1)[:, 0]
-        dist = qr_norm[:, None] - 2.0 * ip + code_norms[lists]
-        dist = jnp.maximum(dist, 0.0)
+        vids = ids[lists].reshape(nq, bcap)
         if metric == "inner_product":
-            # ⟨q, c + r̂⟩ = ⟨q, c⟩ + ⟨q, r̂⟩ ; reuse the ip LUT with q (not qr)
-            q_sub = qf.reshape(nq, m, ds)
-            lut_q = jnp.einsum("qms,mcs->qmc", q_sub, codebooks,
-                               preferred_element_type=jnp.float32)
-            ip_q = jnp.sum(
-                jnp.take_along_axis(lut_q, lcodes.transpose(0, 2, 1), axis=2),
-                axis=1,
-            )
-            qc = qf @ centroids.T
-            qc_sel = jnp.take_along_axis(qc, lists[:, None], axis=1)
-            dist = -(qc_sel + ip_q)
-        valid = jnp.arange(cap)[None, :] < counts[lists][:, None]
-        vids = ids[lists]
-        valid = valid & (vids >= 0)
+            # ⟨q, c + r̂⟩ = ⟨q, c⟩ + ⟨q, r̂⟩ — both terms precomputed
+            qc_sel = jnp.take_along_axis(qc, lists, axis=1)   # [nq, B]
+            dist = -(qc_sel[:, :, None]
+                     + ip.reshape(nq, B, cap)).reshape(nq, bcap)
+        else:
+            cd_sel = jnp.take_along_axis(cd, lists, axis=1)   # [nq, B]
+            dist = (cd_sel[:, :, None] - 2.0 * ip.reshape(nq, B, cap)
+                    + adc_norms[lists]).reshape(nq, bcap)
+            dist = jnp.maximum(dist, 0.0)
+        valid = (jnp.arange(cap)[None, None, :]
+                 < counts[lists][:, :, None]).reshape(nq, bcap)
+        valid = valid & (vids >= 0) & jnp.repeat(pv, cap)[None, :]
         if keep is not None:  # prefilter by source id (True = keep)
             from ._packing import keep_lookup
 
             valid = valid & keep_lookup(keep, vids)
         dist = jnp.where(valid, dist, jnp.inf)
-        return tile_knn_merge(best_val, best_idx, dist, vids, k), None
+        return tile_knn_merge(best_val, best_idx, dist, vids, k,
+                              sorted=False), None
 
     init = (jnp.full((nq, k), jnp.inf, jnp.float32),
             jnp.full((nq, k), -1, jnp.int32))
-    (bv, bi), _ = jax.lax.scan(step, init, jnp.arange(n_probes))
+    (bv, bi), _ = jax.lax.scan(step, init, (lists_xs, pvalid))
+    from ..matrix.select_k import select_k
+
+    bv, bi = select_k(bv, k, in_idx=bi, select_min=True)
     if metric == "euclidean":
         bv = jnp.sqrt(jnp.maximum(bv, 0.0))
     elif metric == "inner_product":
@@ -569,13 +693,15 @@ def search(index: IvfPqIndex, queries, k: int,
     ``core.Bitset``/(n,) bools or a per-query ``core.Bitmap``/(nq, n)
     bools (cuVS bitset/bitmap filter parity)."""
     from ._packing import (as_keep_mask, check_filter_covers_ids,
-                           sentinel_filtered_ids)
+                           resolve_probe_block, sentinel_filtered_ids)
 
     p = params or IvfPqSearchParams()
     q = wrap_array(queries, ndim=2, name="queries")
     expects(q.shape[1] == index.dim, "query dim mismatch")
     expects(p.mode in ("auto", "recon", "lut"), f"unknown mode {p.mode!r}")
     n_probes = min(p.n_probes, index.n_lists)
+    probe_block = resolve_probe_block(p.probe_block, int(n_probes),
+                                      index.list_cap, "ivf_pq")
     keep = as_keep_mask(filter, nq=q.shape[0])  # indexes source ids
     if keep is not None:
         check_filter_covers_ids(keep, index.ids)
@@ -588,12 +714,16 @@ def search(index: IvfPqIndex, queries, k: int,
                 "index.with_recon() (e.g. after load_index)")
         impl = lambda qc, kc: _search_recon_impl(
             index.centroids, index.recon, index.recon_norms, index.ids,
-            qc, int(k), int(n_probes), index.metric, kc)
+            qc, int(k), int(n_probes), index.metric, kc, probe_block)
     else:
+        # legacy/hand-built indexes without the hoisted-ADC tables:
+        # derive them here (per call — materialize with with_adc_luts()
+        # once to amortize, as build/load already do)
+        index = index.with_adc_luts()
         impl = lambda qc, kc: _search_lut_impl(
-            index.centroids, index.codebooks, index.codes, index.code_norms,
+            index.centroids, index.codebooks, index.codes, index.adc_norms,
             index.ids, index.counts, qc, int(k), int(n_probes), index.metric,
-            kc)
+            kc, probe_block)
     dv, di = chunked_filtered_queries(impl, q, int(p.query_chunk), keep)
     if keep is not None:  # sub-k survivors: sentinel tail, not real ids
         di = sentinel_filtered_ids(dv, di)
@@ -608,10 +738,14 @@ def searcher(index: IvfPqIndex, k: int,
     Mode resolution matches :func:`search` (``auto`` → recon tier when the
     slab is materialized, LUT otherwise); index state rides as operands so
     per-bucket executables never embed slab copies."""
+    from ._packing import resolve_probe_block
+
     p = params or IvfPqSearchParams()
     expects(k >= 1, "k must be >= 1")
     expects(p.mode in ("auto", "recon", "lut"), f"unknown mode {p.mode!r}")
     n_probes = int(min(p.n_probes, index.n_lists))
+    probe_block = resolve_probe_block(p.probe_block, n_probes,
+                                      index.list_cap, "ivf_pq")
     metric = index.metric
     mode = p.mode
     if mode == "auto":
@@ -623,18 +757,21 @@ def searcher(index: IvfPqIndex, k: int,
 
         def fn(q, centroids, recon, recon_norms, ids):
             return _search_recon_impl(centroids, recon, recon_norms, ids,
-                                      q, int(k), n_probes, metric, None)
+                                      q, int(k), n_probes, metric, None,
+                                      probe_block)
 
         return fn, (index.centroids, index.recon, index.recon_norms,
                     index.ids)
 
-    def fn(q, centroids, codebooks, codes, code_norms, ids, counts):
-        return _search_lut_impl(centroids, codebooks, codes, code_norms,
+    index = index.with_adc_luts()  # once, here — operands carry the tables
+
+    def fn(q, centroids, codebooks, codes, adc_norms, ids, counts):
+        return _search_lut_impl(centroids, codebooks, codes, adc_norms,
                                 ids, counts, q, int(k), n_probes, metric,
-                                None)
+                                None, probe_block)
 
     return fn, (index.centroids, index.codebooks, index.codes,
-                index.code_norms, index.ids, index.counts)
+                index.adc_norms, index.ids, index.counts)
 
 
 # ---------------------------------------------------------------------------
@@ -669,7 +806,7 @@ def _sharded_coarse_program(mesh, axis: str, per: int, n_lists_local: int,
         # integer corpora
         return c, xt.astype(c.dtype) - c[lbl]
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         local, mesh=mesh, in_specs=P(axis), out_specs=(P(axis), P(axis)),
         check_vma=False,
     ))
@@ -699,11 +836,14 @@ def _sharded_encode_program(mesh, axis: str, n_orig: int, per: int,
         else:  # static-shape placeholders dropped by the caller
             rec = jnp.zeros((n_lists_local, 1, 1), jnp.bfloat16)
             rnorms = jnp.zeros((n_lists_local, 1), jnp.float32)
-        return pk_codes, pk_norms, pk_ids, counts, rec, rnorms
+        # hoisted-ADC tables per LOCAL lists — elementwise over the list
+        # axis, so the shard layout is preserved without cross-device moves
+        clut, anorms = _adc_tables(pk_codes, c_l, codebooks, pk_norms)
+        return pk_codes, pk_norms, pk_ids, counts, rec, rnorms, clut, anorms
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         local, mesh=mesh, in_specs=(P(axis), P(axis), P()),
-        out_specs=(P(axis),) * 6, check_vma=False,
+        out_specs=(P(axis),) * 8, check_vma=False,
     ))
 
 
@@ -748,22 +888,25 @@ def build_sharded(dataset, mesh, params: Optional[IvfPqIndexParams] = None,
 
     encode = _sharded_encode_program(
         mesh, axis, n, per, n_lists_local, cap, m, bool(p.store_recon))
-    codes, cnorms, ids, counts, rec, rnorms = encode(x_sh, centroids, codebooks)
+    codes, cnorms, ids, counts, rec, rnorms, clut, anorms = encode(
+        x_sh, centroids, codebooks)
     index = IvfPqIndex(
         centroids, codebooks, codes, cnorms, ids, counts, p.metric,
         rec if p.store_recon else None,
         rnorms if p.store_recon else None,
+        centroid_lut=clut, adc_norms=anorms,
     )
     # packing is elementwise, so it preserves the per-shard layout
     return index.with_packed_codes() if p.pack_codes else index
 
 
 @partial(jax.jit, static_argnames=("k", "n_probes", "metric", "axis", "mesh",
-                                   "mode", "data_axis"))
-def _search_sharded_impl(mesh, axis, centroids, codebooks, codes, code_norms,
+                                   "mode", "data_axis", "probe_block"))
+def _search_sharded_impl(mesh, axis, centroids, codebooks, codes, adc_norms,
                          ids, counts, recon, recon_norms, q,
                          k: int, n_probes: int, metric: str, mode: str,
-                         data_axis: Optional[str] = None, keep=None):
+                         data_axis: Optional[str] = None, keep=None,
+                         probe_block: int = 1):
     from jax.sharding import PartitionSpec as P
 
     def merge(bv, bi, nq_l):
@@ -789,28 +932,28 @@ def _search_sharded_impl(mesh, axis, centroids, codebooks, codes, code_norms,
         def local(centroids_l, recon_l, recon_norms_l, ids_l, q_l, keep_l):
             bv, bi = _search_recon_impl(centroids_l, recon_l, recon_norms_l,
                                         ids_l, q_l, k, n_probes, metric,
-                                        keep_l)
+                                        keep_l, probe_block)
             return merge(bv, bi, q_l.shape[0])
 
-        return jax.shard_map(
+        return shard_map(
             local, mesh=mesh,
             in_specs=(P(axis), P(axis), P(axis), P(axis), qspec, kspec),
             out_specs=(qspec, qspec), check_vma=False,
         )(centroids, recon, recon_norms, ids, q, keep)
 
-    def local(centroids_l, codebooks_l, codes_l, code_norms_l, ids_l,
+    def local(centroids_l, codebooks_l, codes_l, adc_norms_l, ids_l,
               counts_l, q_l, keep_l):
         bv, bi = _search_lut_impl(centroids_l, codebooks_l, codes_l,
-                                  code_norms_l, ids_l, counts_l, q_l,
-                                  k, n_probes, metric, keep_l)
+                                  adc_norms_l, ids_l, counts_l, q_l,
+                                  k, n_probes, metric, keep_l, probe_block)
         return merge(bv, bi, q_l.shape[0])
 
-    return jax.shard_map(
+    return shard_map(
         local, mesh=mesh,
         in_specs=(P(axis), P(), P(axis), P(axis), P(axis), P(axis), qspec,
                   kspec),
         out_specs=(qspec, qspec), check_vma=False,
-    )(centroids, codebooks, codes, code_norms, ids, counts, q, keep)
+    )(centroids, codebooks, codes, adc_norms, ids, counts, q, keep)
 
 
 def search_sharded(index: IvfPqIndex, queries, k: int,
@@ -826,7 +969,7 @@ def search_sharded(index: IvfPqIndex, queries, k: int,
     ``filter``: bitset/bitmap prefilter over GLOBAL source ids, same
     contract as :func:`search` (replicated over the shard axis)."""
     from ._packing import (as_keep_mask, check_filter_covers_ids,
-                           sentinel_filtered_ids)
+                           resolve_probe_block, sentinel_filtered_ids)
 
     p = params or IvfPqSearchParams()
     q = wrap_array(queries, ndim=2, name="queries")
@@ -835,6 +978,8 @@ def search_sharded(index: IvfPqIndex, queries, k: int,
     n_dev = int(mesh.shape[axis])
     local_lists = index.n_lists // n_dev
     n_probes = min(p.n_probes, local_lists)
+    probe_block = resolve_probe_block(p.probe_block, int(n_probes),
+                                      index.list_cap, "ivf_pq")
     if data_axis is not None:
         expects(data_axis in mesh.axis_names, f"axis {data_axis!r} not in mesh")
         expects(q.shape[0] % int(mesh.shape[data_axis]) == 0,
@@ -849,12 +994,19 @@ def search_sharded(index: IvfPqIndex, queries, k: int,
         expects(index.recon is not None,
                 "mode='recon' needs the reconstruction slab — call "
                 "index.with_recon() (e.g. after load_index)")
+    elif index.adc_norms is None:
+        # hoisted-ADC tables are elementwise over the list axis, so this
+        # preserves a sharded index's layout (build_sharded pre-computes
+        # them inside the encode program; this covers hand-built indexes)
+        index = index.with_adc_luts()
     dv, di = _search_sharded_impl(mesh, axis, index.centroids,
                                   index.codebooks, index.codes,
-                                  index.code_norms, index.ids, index.counts,
+                                  index.adc_norms if mode == "lut"
+                                  else index.code_norms,
+                                  index.ids, index.counts,
                                   index.recon, index.recon_norms,
                                   q, int(k), int(n_probes), index.metric,
-                                  mode, data_axis, keep)
+                                  mode, data_axis, keep, probe_block)
     if keep is not None:
         di = sentinel_filtered_ids(dv, di)
     return dv, di

@@ -86,8 +86,23 @@ def load_index(path: Union[str, os.PathLike], *, device: bool = True):
     for name, arr in arrays.items():
         fields[name] = jax.device_put(arr) if device else arr
     index = cls(**fields)
-    if meta.get("derived_present") and device and hasattr(index, "with_recon"):
-        index = index.with_recon()  # rebuild the derived search tier
+    if device:
+        index = _rebuild_derived(index, meta)
+    return index
+
+
+def _rebuild_derived(index, meta):
+    """Rebuild exactly the derived search tiers the artifact carried when
+    it was saved (``derived_present``) — a ``store_recon=False`` index must
+    not grow a recon slab on load.  The hoisted-ADC tables ride the same
+    mechanism; artifacts from before they existed rebuild them too (they
+    are cheap, and LUT search derives them on the fly otherwise), keeping
+    old artifacts fully usable without a format-version bump."""
+    present = set(meta.get("derived_present") or ())
+    if "recon" in present and hasattr(index, "with_recon"):
+        index = index.with_recon()
+    if hasattr(index, "with_adc_luts"):
+        index = index.with_adc_luts()
     return index
 
 
@@ -221,6 +236,4 @@ def load_index_checkpoint(path: Union[str, os.PathLike], *, shardings=None):
         fields[name] = arr if isinstance(arr, jax.Array) \
             else jax.device_put(arr)
     index = cls(**fields)
-    if meta.get("derived_present") and hasattr(index, "with_recon"):
-        index = index.with_recon()
-    return index
+    return _rebuild_derived(index, meta)

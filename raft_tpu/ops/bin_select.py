@@ -24,7 +24,8 @@ import jax.numpy as jnp
 __all__ = ["bin_select_k"]
 
 
-@partial(jax.jit, static_argnames=("k", "select_min", "n_bins", "n_rounds"))
+@partial(jax.jit, static_argnames=("k", "select_min", "n_bins", "n_rounds",
+                                   "sorted"))
 def bin_select_k(
     in_val: jax.Array,
     k: int,
@@ -32,8 +33,13 @@ def bin_select_k(
     select_min: bool = True,
     n_bins: int = 32,
     n_rounds: int = 3,
+    sorted: bool = True,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Select k smallest/largest per row via iterative bin refinement."""
+    """Select k smallest/largest per row via iterative bin refinement.
+
+    ``sorted=False`` skips the final ranked ``top_k`` over the boundary
+    band: ties are still resolved exactly, but via ``argpartition``, so the
+    returned k pairs come back in unspecified order."""
     x = in_val if select_min else -in_val
     x = x.astype(jnp.float32)
     batch, length = x.shape
@@ -76,8 +82,12 @@ def bin_select_k(
     # hi to +inf leaves ~k candidates, so top_k runs over a mostly-degenerate
     # key set (cheap) while returning exactly the k smallest originals.
     surrogate = jnp.where(x <= hi[:, None], x, jnp.inf)
-    neg_vals, idx = jax.lax.top_k(-surrogate, k)
-    vals = -neg_vals
+    if sorted:
+        neg_vals, idx = jax.lax.top_k(-surrogate, k)
+        vals = -neg_vals
+    else:  # exact selection without the final ordering pass
+        idx = jnp.argpartition(surrogate, k - 1, axis=1)[:, :k]
+        vals = jnp.take_along_axis(surrogate, idx, axis=1)
     if not select_min:
         vals = -vals
     return vals.astype(in_val.dtype), idx

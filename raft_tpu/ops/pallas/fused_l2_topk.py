@@ -36,6 +36,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+try:  # pre-0.6 runtimes carry the old TPUCompilerParams spelling
+    _CompilerParams = pltpu.CompilerParams
+except AttributeError:
+    _CompilerParams = pltpu.TPUCompilerParams
+
 __all__ = ["fused_shortlist"]
 
 
@@ -91,7 +96,7 @@ def _call(xb, yb, yn, bm, bn, interpret):
         ],
         out_specs=(out_spec, out_spec, out_spec, out_spec),
         out_shape=(out_shape, idx_shape, out_shape, idx_shape),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,

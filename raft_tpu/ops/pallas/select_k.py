@@ -30,6 +30,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+try:  # pre-0.6 runtimes carry the old TPUCompilerParams spelling
+    _CompilerParams = pltpu.CompilerParams
+except AttributeError:
+    _CompilerParams = pltpu.TPUCompilerParams
+
 __all__ = ["select_k_pallas"]
 
 _LANES = 128  # TPU lane width: pad k to a full lane tile
@@ -99,7 +104,7 @@ def _call(x, k: int, bm: int, bn: int, interpret: bool):
             jax.ShapeDtypeStruct((grid[0] * bm, kpad), jnp.float32),
             jax.ShapeDtypeStruct((grid[0] * bm, kpad), jnp.int32),
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,

@@ -94,7 +94,13 @@ def make_searcher(index, k: int, params=None, *, effort_scale: float = 1.0,
     """Build the ``(fn, operands)`` searcher for ``index`` at one
     degradation point.  ``effort_scale`` in (0, 1] multiplies the
     family's effort knob; 1.0 reproduces direct ``search()`` exactly
-    (the serve bit-identity contract)."""
+    (the serve bit-identity contract).
+
+    Only the effort knob is scaled — every other search param passes
+    through unchanged.  In particular the IVF families' ``probe_block``
+    (blocked probe scan; 0 = auto-tuned) reaches the baked executable
+    as given: it changes wall-clock only, never results, so degradation
+    ladders keep one blocking choice across all effort levels."""
     expects(0.0 < effort_scale <= 1.0,
             f"effort_scale must be in (0, 1], got {effort_scale}")
     fam = family_of(index)

@@ -7,8 +7,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
+
+from raft_tpu.core.compat import shard_map
 
 from raft_tpu import comms as comms_mod
 from raft_tpu.comms import Comms, Op, selftest

@@ -71,6 +71,52 @@ class TestSelectK:
         ref_vals, _ = select_k_reference(x, 512)
         np.testing.assert_allclose(np.sort(np.asarray(vals)), np.sort(ref_vals), rtol=1e-6)
 
+    @pytest.mark.parametrize("select_min", [True, False])
+    # no int64: jax demotes it to int32 without x64 mode, so the output
+    # dtype (and pad extreme) would be int32's, not the input's
+    @pytest.mark.parametrize("dtype", [np.int32, np.uint8])
+    def test_k_larger_than_length_integer_pads(self, rng, dtype, select_min):
+        # integer rows can't pad with inf — regression: this used to raise
+        # inside jnp.full; pads must use the dtype's never-selected extreme
+        x = rng.integers(0, 50, size=(2, 4)).astype(dtype)
+        vals, idx = matrix.select_k(x, 6, select_min=select_min)
+        assert vals.shape == (2, 6) and vals.dtype == dtype
+        info = np.iinfo(dtype)
+        want_pad = info.max if select_min else info.min
+        assert (np.asarray(vals)[:, 4:] == want_pad).all()
+        assert (np.asarray(idx)[:, 4:] == -1).all()
+        # the real entries are still the full (sorted) row
+        ref_vals, _ = select_k_reference(x.astype(np.int64), 4,
+                                         select_min=select_min)
+        np.testing.assert_array_equal(np.asarray(vals)[:, :4].astype(np.int64),
+                                      ref_vals)
+
+    @pytest.mark.parametrize("algo", ALGOS)
+    @pytest.mark.parametrize("select_min", [True, False])
+    def test_unsorted_returns_exact_set(self, rng, algo, select_min):
+        # sorted=False relaxes only the ORDER: the (value, index) pairs
+        # must still be exactly the top-k set.  Never assert the output is
+        # actually unordered — argpartition may legally return sorted rows.
+        x = rng.standard_normal((8, 300)).astype(np.float32)
+        k = 17
+        vals, idx = matrix.select_k(x, k, select_min=select_min,
+                                    sorted=False, algo=algo)
+        ref_vals, _ = select_k_reference(x, k, select_min=select_min)
+        np.testing.assert_allclose(np.sort(np.asarray(vals), axis=1),
+                                   np.sort(ref_vals, axis=1), rtol=1e-6)
+        gathered = np.take_along_axis(x, np.asarray(idx), axis=1)
+        np.testing.assert_allclose(gathered, np.asarray(vals), rtol=1e-6)
+
+    def test_unsorted_k_ge_length_whole_row(self, rng):
+        # k >= length routes to kSortFull; unsorted must still return every
+        # element exactly once (the blocked-scan carry relies on this)
+        x = rng.standard_normal((3, 9)).astype(np.float32)
+        vals, idx = matrix.select_k(x, 9, sorted=False)
+        np.testing.assert_allclose(np.sort(np.asarray(vals), axis=1),
+                                   np.sort(x, axis=1), rtol=0)
+        for row in np.asarray(idx):
+            assert sorted(row.tolist()) == list(range(9))
+
 
 class TestGatherScatter:
     def test_gather(self, rng):

@@ -1,0 +1,259 @@
+"""Probe-blocked IVF search: bit-exact parity with the per-probe scan.
+
+The blocked engine (``probe_block`` search param) gathers B probe lists
+per scan step and merges once per block instead of once per probe.  The
+per-candidate arithmetic is identical for every block size — same
+elementwise op order, same masks — so results must match the per-probe
+scan **bit-for-bit** (values AND ids), at every block size, including
+block sizes that don't divide ``n_probes`` (pad probes are masked, never
+duplicated).  These tests pin that contract for both families, both
+IVF-PQ tiers, packed 4-bit codes, filtered search, and the sharded path,
+plus steady-state behavior when block sizes are mixed at runtime.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.core import TraceGuard
+from raft_tpu.neighbors import ivf_flat, ivf_pq
+from raft_tpu.neighbors._packing import blocked_probe_plan, resolve_probe_block
+from raft_tpu.random.datagen import make_blobs
+
+K = 10
+N_PROBES = 25  # deliberately not a multiple of the tested block sizes
+BLOCKS = (1, 4, N_PROBES)
+METRICS = ("sqeuclidean", "inner_product")
+
+
+@pytest.fixture(scope="module")
+def data():
+    x, _ = make_blobs(jax.random.PRNGKey(3), n_samples=4000, n_features=32,
+                      n_clusters=40, cluster_std=1.2)
+    return np.asarray(x), np.asarray(x[:64]) + 0.05
+
+
+@pytest.fixture(scope="module")
+def flat_indexes(data):
+    x, _ = data
+    # 60 lists → a list cap that is NOT lane-aligned: einsum retiling
+    # masked by power-of-two caps shows up here (the pq fixture's cap is
+    # odd already via its 1.5 cap ratio)
+    return {m: ivf_flat.build(x, ivf_flat.IvfFlatIndexParams(
+        n_lists=60, metric=m, seed=7)) for m in METRICS}
+
+
+@pytest.fixture(scope="module")
+def pq_indexes(data):
+    x, _ = data
+    return {m: ivf_pq.build(x, ivf_pq.IvfPqIndexParams(
+        n_lists=64, pq_dim=8, metric=m, seed=7)) for m in METRICS}
+
+
+@pytest.fixture(scope="module")
+def packed_pq_index(data):
+    x, _ = data
+    idx = ivf_pq.build(x, ivf_pq.IvfPqIndexParams(
+        n_lists=64, pq_dim=8, pq_bits=4, pack_codes=True, seed=7))
+    assert idx.packed
+    return idx
+
+
+def _run_flat(index, q, pb, filt=None):
+    p = ivf_flat.IvfFlatSearchParams(n_probes=N_PROBES, probe_block=pb)
+    d, i = ivf_flat.search(index, q, K, p, filter=filt)
+    return np.asarray(d), np.asarray(i)
+
+
+def _run_pq(index, q, mode, pb, filt=None):
+    p = ivf_pq.IvfPqSearchParams(n_probes=N_PROBES, mode=mode,
+                                 probe_block=pb)
+    d, i = ivf_pq.search(index, q, K, p, filter=filt)
+    return np.asarray(d), np.asarray(i)
+
+
+def _assert_identical(ref, got, ctx):
+    np.testing.assert_array_equal(ref[0], got[0], err_msg=f"values {ctx}")
+    np.testing.assert_array_equal(ref[1], got[1], err_msg=f"ids {ctx}")
+
+
+# ---------------------------------------------------------------------------
+# bit-exact parity across block sizes
+
+
+@pytest.mark.parametrize("metric", METRICS)
+def test_ivf_flat_blocked_parity(flat_indexes, data, metric):
+    _, q = data
+    index = flat_indexes[metric]
+    ref = _run_flat(index, q, 1)
+    for pb in BLOCKS[1:]:
+        _assert_identical(ref, _run_flat(index, q, pb),
+                          f"flat {metric} pb={pb}")
+
+
+@pytest.mark.parametrize("mode", ["recon", "lut"])
+@pytest.mark.parametrize("metric", METRICS)
+def test_ivf_pq_blocked_parity(pq_indexes, data, metric, mode):
+    _, q = data
+    index = pq_indexes[metric]
+    ref = _run_pq(index, q, mode, 1)
+    for pb in BLOCKS[1:]:
+        _assert_identical(ref, _run_pq(index, q, mode, pb),
+                          f"pq {mode} {metric} pb={pb}")
+
+
+def test_ivf_pq_packed_blocked_parity(packed_pq_index, data):
+    """4-bit packed codes: the in-scan unpack composes with blocking."""
+    _, q = data
+    ref = _run_pq(packed_pq_index, q, "lut", 1)
+    for pb in BLOCKS[1:]:
+        _assert_identical(ref, _run_pq(packed_pq_index, q, "lut", pb),
+                          f"packed lut pb={pb}")
+
+
+def test_filtered_blocked_parity(flat_indexes, pq_indexes, data):
+    """Blocked gathers flatten probe-block vids before the bitmap lookup —
+    filtered results must stay bit-identical at every block size, for
+    both the shared-bitset and the per-query-bitmap filter forms."""
+    x, q = data
+    n = x.shape[0]
+    rng = np.random.default_rng(11)
+    bitset = rng.random(n) < 0.6                      # shared over queries
+    bitmap = rng.random((q.shape[0], n)) < 0.6        # per-query
+    fi, pi = flat_indexes["sqeuclidean"], pq_indexes["sqeuclidean"]
+    for filt in (bitset, bitmap):
+        ref_f = _run_flat(fi, q, 1, filt)
+        ref_p = _run_pq(pi, q, "lut", 1, filt)
+        for pb in BLOCKS[1:]:
+            _assert_identical(ref_f, _run_flat(fi, q, pb, filt),
+                              f"flat filtered pb={pb} ndim={np.ndim(filt)}")
+            _assert_identical(ref_p, _run_pq(pi, q, "lut", pb, filt),
+                              f"pq filtered pb={pb} ndim={np.ndim(filt)}")
+
+
+def test_sharded_blocked_parity(data, mesh8):
+    x, q = data
+    sf = ivf_flat.build_sharded(x, mesh8,
+                                ivf_flat.IvfFlatIndexParams(n_lists=64, seed=7))
+    ref = None
+    for pb in BLOCKS:
+        d, i = ivf_flat.search_sharded(
+            sf, q, K, ivf_flat.IvfFlatSearchParams(n_probes=N_PROBES,
+                                                   probe_block=pb),
+            mesh=mesh8)
+        got = (np.asarray(d), np.asarray(i))
+        if ref is None:
+            ref = got
+        else:
+            _assert_identical(ref, got, f"sharded flat pb={pb}")
+
+    sp = ivf_pq.build_sharded(x, mesh8,
+                              ivf_pq.IvfPqIndexParams(n_lists=64, pq_dim=8,
+                                                      seed=7))
+    for mode in ("recon", "lut"):
+        ref = None
+        for pb in BLOCKS:
+            d, i = ivf_pq.search_sharded(
+                sp, q, K, ivf_pq.IvfPqSearchParams(n_probes=N_PROBES,
+                                                   mode=mode, probe_block=pb),
+                mesh=mesh8)
+            got = (np.asarray(d), np.asarray(i))
+            if ref is None:
+                ref = got
+            else:
+                _assert_identical(ref, got, f"sharded pq {mode} pb={pb}")
+
+
+# ---------------------------------------------------------------------------
+# hoisted ADC tables
+
+
+def test_adc_tables_match_fresh_rebuild(pq_indexes):
+    """Build-time tables == tables rebuilt from persisted state alone."""
+    index = pq_indexes["sqeuclidean"]
+    rebuilt = dataclasses.replace(index, centroid_lut=None,
+                                  adc_norms=None).with_adc_luts()
+    np.testing.assert_array_equal(np.asarray(index.centroid_lut),
+                                  np.asarray(rebuilt.centroid_lut))
+    np.testing.assert_array_equal(np.asarray(index.adc_norms),
+                                  np.asarray(rebuilt.adc_norms))
+
+
+def test_legacy_index_without_tables_still_searches(pq_indexes, data):
+    """An index lacking the precomputed tables (old artifact shape) must
+    produce identical LUT results — search derives the tables on the fly."""
+    _, q = data
+    index = pq_indexes["sqeuclidean"]
+    legacy = dataclasses.replace(index, centroid_lut=None, adc_norms=None)
+    ref = _run_pq(index, q, "lut", 4)
+    got = _run_pq(legacy, q, "lut", 4)
+    _assert_identical(ref, got, "legacy vs precomputed tables")
+
+
+# ---------------------------------------------------------------------------
+# probe-block planning units
+
+
+def test_blocked_probe_plan_shapes_and_masks():
+    probes = jnp.arange(12).reshape(2, 6)  # nq=2, n_probes=6
+    xs, pvalid = blocked_probe_plan(probes, 4)
+    assert xs.shape == (2, 2, 4)          # [n_blocks, nq, B]
+    assert pvalid.shape == (2, 4)
+    # pad probes are masked invalid, real probes valid, order preserved
+    np.testing.assert_array_equal(np.asarray(pvalid),
+                                  [[True] * 4, [True, True, False, False]])
+    flat = np.moveaxis(np.asarray(xs), 0, 1).reshape(2, -1)[:, :6]
+    np.testing.assert_array_equal(flat, np.arange(12).reshape(2, 6))
+
+
+def test_blocked_probe_plan_exact_division():
+    probes = jnp.arange(8).reshape(2, 4)
+    xs, pvalid = blocked_probe_plan(probes, 2)
+    assert xs.shape == (2, 2, 2) and bool(pvalid.all())
+
+
+def test_resolve_probe_block_clamps():
+    # explicit request clamps into [1, n_probes]
+    assert resolve_probe_block(4, 32, 512, "ivf_flat") == 4
+    assert resolve_probe_block(64, 32, 512, "ivf_flat") == 32
+    assert resolve_probe_block(-3, 32, 512, "ivf_flat") == 1
+    # auto (0): always a valid block size
+    for n_probes in (1, 2, 7, 32, 257):
+        for cap in (1, 64, 4096, 100_000):
+            got = resolve_probe_block(0, n_probes, cap, "ivf_pq")
+            assert 1 <= got <= n_probes, (n_probes, cap, got)
+
+
+# ---------------------------------------------------------------------------
+# steady state across mixed block sizes
+
+
+def test_mixed_probe_block_steady_state(flat_indexes, pq_indexes, data):
+    """Each distinct probe_block is its own specialization; once each is
+    warm, alternating between them must not re-trace or transfer."""
+    _, q = data
+    qd = jax.device_put(jnp.asarray(q))
+    fi, pi = flat_indexes["sqeuclidean"], pq_indexes["sqeuclidean"]
+
+    def run(pb):
+        d, i = ivf_flat.search(
+            fi, qd, K,
+            ivf_flat.IvfFlatSearchParams(n_probes=N_PROBES, probe_block=pb))
+        d2, i2 = ivf_pq.search(
+            pi, qd, K,
+            ivf_pq.IvfPqSearchParams(n_probes=N_PROBES, mode="lut",
+                                     probe_block=pb))
+        jax.block_until_ready((d, i, d2, i2))
+
+    for pb in (1, 4):  # warm both specializations
+        run(pb)
+    with TraceGuard() as tg, jax.transfer_guard("disallow"):
+        for _ in range(4):
+            run(1)
+            run(4)
+    tg.assert_steady_state()
