@@ -1,0 +1,169 @@
+"""Build-throughput A/B — pipelined chunk engine vs the per-op loop.
+
+Measures the streaming phase of ``build_chunked`` (training excluded —
+it is byte-identical in both engines) as rows/s, plus end-to-end
+time-to-index, for both IVF families:
+
+* **perop** — the pre-pipelining reference loop kept verbatim as
+  ``_stream_perop`` / ``_pq_stream_perop``: blocking ``jnp.asarray``
+  H2D, separate assign / residual / encode / scatter dispatches, tail
+  chunk at its own shape (one extra compile).
+* **pipelined** — the PR 4 engine: fixed-shape padded chunks, one fused
+  slab-donating jitted program per chunk
+  (``_flat_chunk_step`` / ``_pq_chunk_step``), chunk t+1 staged with a
+  non-blocking ``device_put`` while chunk t computes.
+
+Both engines produce BIT-identical indexes
+(tests/test_chunked_builds.py), so this is pure wall-clock — no recall
+gate.  The acceptance grid point is 1M rows; on CPU the win comes from
+collapsing per-chunk dispatch overhead and letting XLA fuse the whole
+chunk program (single-stream backend — the H2D overlap is free but
+empty); on TPU the overlap additionally hides the PCIe chunk copy.
+
+    python bench/build_throughput.py [--quick] [--cpu]
+
+Writes ``bench/BUILD_THROUGHPUT_<BACKEND>.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.expanduser("~/.cache/raft_tpu_jax"))
+
+import jax
+
+from _platform import pin_backend
+
+# MUST precede any backend use (see _platform.py: the axon plugin's
+# sitecustomize overrides a bare JAX_PLATFORMS env var)
+pin_backend(sys.argv)
+
+import time
+
+import numpy as np
+
+from _timing import sync, timeit
+from raft_tpu.neighbors import ivf_flat, ivf_pq
+
+# (family, rows, dim, n_lists, chunk_rows): the 1M acceptance point runs
+# at a small chunk size — the dispatch-bound regime the fusion targets
+# (the default 65536-row chunks amortize dispatch so well that both
+# engines converge to the same compute bound) — plus one default-chunk
+# point per family so the artifact also records the compute-bound end.
+# ivf_pq runs 4-bit codebooks (the pack_codes deployment shape): with the
+# encode compute small, the per-op loop's eager residual gather/subtract
+# and extra dispatches dominate, which is exactly what the fusion removes.
+GRID = [
+    ("ivf_flat", 1_000_000, 64, 64, 128),
+    ("ivf_flat", 1_000_000, 64, 64, 65536),
+    ("ivf_pq", 1_000_000, 64, 64, 128),
+    ("ivf_pq", 1_000_000, 64, 64, 65536),
+]
+QUICK_GRID = [("ivf_flat", 100_000, 64, 64, 128),
+              ("ivf_pq", 100_000, 64, 64, 128)]
+# training is byte-identical in both engines and excluded from the
+# timings — keep it short so the bench spends its budget on the streams
+TRAIN_FRACTION, TRAIN_ITERS = 0.02, 5
+REPS = 3
+
+
+def _params(family: str, n_lists: int):
+    if family == "ivf_flat":
+        return ivf_flat.IvfFlatIndexParams(
+            n_lists=n_lists, kmeans_trainset_fraction=TRAIN_FRACTION,
+            kmeans_n_iters=TRAIN_ITERS, seed=0)
+    return ivf_pq.IvfPqIndexParams(
+        n_lists=n_lists, pq_dim=16, pq_bits=4,
+        kmeans_trainset_fraction=TRAIN_FRACTION,
+        kmeans_n_iters=TRAIN_ITERS, pq_kmeans_n_iters=5, seed=0)
+
+
+def _streams(family: str, x, p, chunk_rows: int):
+    """Return zero-arg thunks (perop, pipelined) over a shared trained
+    quantizer — streaming only, training off the clock."""
+    n, d = x.shape
+    if family == "ivf_flat":
+        cap = max(1, int(np.ceil(p.list_cap_ratio * n / p.n_lists)))
+        cents = ivf_flat._coarse_train_chunked(x, p, n)
+        sync(cents)
+        dt = cents.dtype
+        perop = lambda: ivf_flat._stream_perop(
+            x, cents, p, n, cap, chunk_rows, None, dt)
+        pipe = lambda: ivf_flat._stream_pipelined(
+            x, cents, p, n, cap, chunk_rows, None, dt)
+        return perop, pipe
+    m = p.pq_dim
+    cap = max(1, int(np.ceil(p.list_cap_ratio * n / p.n_lists)))
+    cents, cbs = ivf_pq._pq_train_chunked(x, p, n, m, 1 << p.pq_bits)
+    sync((cents, cbs))
+    perop = lambda: ivf_pq._pq_stream_perop(
+        x, cents, cbs, p, n, m, cap, chunk_rows, None)
+    pipe = lambda: ivf_pq._pq_stream_pipelined(
+        x, cents, cbs, p, n, m, cap, chunk_rows, None)
+    return perop, pipe
+
+
+def main() -> None:
+    quick = "--quick" in sys.argv
+    grid = QUICK_GRID if quick else GRID
+    backend = jax.default_backend()
+    rng = np.random.default_rng(0)
+    results = []
+    x_cache = {}
+    for family, rows, dim, n_lists, chunk_rows in grid:
+        if x_cache.get("shape") != (rows, dim):
+            x_cache = {"shape": (rows, dim),
+                       "x": rng.standard_normal((rows, dim)).astype(np.float32)}
+        x = x_cache["x"]
+        p = _params(family, n_lists)
+        perop, pipe = _streams(family, x, p, chunk_rows)
+        t_perop = timeit(perop, REPS)
+        t_pipe = timeit(pipe, REPS)
+        build = (ivf_flat.build_chunked if family == "ivf_flat"
+                 else ivf_pq.build_chunked)
+        t0 = time.perf_counter()
+        sync(build(x, p, chunk_rows=chunk_rows))
+        tti = time.perf_counter() - t0
+        entry = {
+            "family": family, "rows": rows, "dim": dim,
+            "n_lists": n_lists, "chunk_rows": chunk_rows,
+            "perop_s": round(t_perop, 4),
+            "pipelined_s": round(t_pipe, 4),
+            "perop_rows_per_s": round(rows / t_perop),
+            "pipelined_rows_per_s": round(rows / t_pipe),
+            "speedup": round(t_perop / t_pipe, 3),
+            "time_to_index_s": round(tti, 4),
+        }
+        if family == "ivf_pq":
+            entry["pq_dim"], entry["pq_bits"] = p.pq_dim, p.pq_bits
+        results.append(entry)
+        print(json.dumps(entry), flush=True)
+
+    out = {
+        "bench": "build_throughput",
+        "backend": backend,
+        "mode": "quick" if quick else "full",
+        "reps": REPS,
+        "note": ("streaming-phase rows/s (training excluded — identical "
+                 "in both engines); time_to_index_s is end-to-end "
+                 "build_chunked incl. training at trainset_fraction="
+                 f"{TRAIN_FRACTION}; results bit-identical across engines "
+                 "(tests/test_chunked_builds.py)"),
+        "results": results,
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        f"BUILD_THROUGHPUT_{backend.upper()}.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,192 @@
+"""Offline chunk_rows tuning — pick the streaming-build chunk size per
+``(family, dim)`` bucket by measurement, the same trained-heuristic
+pattern as ``bench/tune_probe_block.py``.
+
+The pipelined chunk engine produces a BIT-identical index for every
+``chunk_rows`` (tests/test_chunked_builds.py), so this tuner compares
+pure streaming wall-clock — no recall gate.  Small chunks pay dispatch
+overhead per chunk; large chunks pay staging-buffer memory and (on TPU)
+a longer exposed first-chunk copy.  Run on the target backend (real TPU
+for production numbers):
+
+    python bench/tune_chunk_rows.py [--quick] [--cpu]
+
+Writes ``raft_tpu/neighbors/_chunk_rows_table.json`` keyed by
+``family:dim.bit_length()`` — ``build_chunked``'s ``chunk_rows=0``
+(auto) consults it via ``resolve_chunk_rows`` at call time; absent
+entries fall back to ``DEFAULT_CHUNK_ROWS``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.expanduser("~/.cache/raft_tpu_jax"))
+
+import jax
+
+from _platform import pin_backend
+
+# MUST precede any backend use (see _platform.py: the axon plugin's
+# sitecustomize overrides a bare JAX_PLATFORMS env var)
+pin_backend(sys.argv)
+
+import numpy as np
+
+from _timing import sync, timeit as _time
+from raft_tpu.neighbors import ivf_flat, ivf_pq
+from raft_tpu.neighbors._packing import resolve_chunk_rows
+
+ROWS, N_LISTS = 400_000, 256
+QUICK_ROWS = 120_000
+DIMS = [64, 96]
+QUICK_DIMS = [64]
+CANDIDATES = [4096, 8192, 16384, 32768, 65536, 131072]
+
+
+def bucket_key(family: str, dim: int) -> str:
+    """Must mirror ``resolve_chunk_rows``'s table key scheme exactly."""
+    return f"{family}:{dim.bit_length()}"
+
+
+def kernel_sha() -> str:
+    """Hash of the chunk-engine sources the timings depend on — recorded
+    in the sidecar (stale-table detection) and scoping the resume
+    checkpoint."""
+    import hashlib
+
+    root = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+    h = hashlib.sha256()
+    for rel in ("raft_tpu/neighbors/ivf_flat.py",
+                "raft_tpu/neighbors/ivf_pq.py",
+                "raft_tpu/neighbors/_packing.py",
+                "raft_tpu/cluster/kmeans.py",
+                "raft_tpu/core/double_buffer.py"):
+        with open(os.path.join(root, rel), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def _stream_fn(family: str, x, chunk_rows: int):
+    """Zero-arg streaming thunk over a shared trained quantizer (training
+    is chunk_rows-independent and stays off the clock)."""
+    n, d = x.shape
+    if family == "ivf_flat":
+        p = ivf_flat.IvfFlatIndexParams(
+            n_lists=N_LISTS, kmeans_trainset_fraction=0.02,
+            kmeans_n_iters=5, seed=0)
+        cap = max(1, int(np.ceil(p.list_cap_ratio * n / p.n_lists)))
+        cents = ivf_flat._coarse_train_chunked(x, p, n)
+        sync(cents)
+        return lambda: ivf_flat._stream_pipelined(
+            x, cents, p, n, cap, chunk_rows, None, cents.dtype)
+    p = ivf_pq.IvfPqIndexParams(
+        n_lists=N_LISTS, pq_dim=16, kmeans_trainset_fraction=0.02,
+        kmeans_n_iters=5, pq_kmeans_n_iters=5, seed=0)
+    m = p.pq_dim
+    cap = max(1, int(np.ceil(p.list_cap_ratio * n / p.n_lists)))
+    cents, cbs = ivf_pq._pq_train_chunked(x, p, n, m, 1 << p.pq_bits)
+    sync((cents, cbs))
+    return lambda: ivf_pq._pq_stream_pipelined(
+        x, cents, cbs, p, n, m, cap, chunk_rows, None)
+
+
+def main() -> None:
+    quick = "--quick" in sys.argv
+    rows = QUICK_ROWS if quick else ROWS
+    dims = QUICK_DIMS if quick else DIMS
+    sha = kernel_sha()
+    backend = jax.default_backend()
+
+    # resume checkpoint: decided buckets flush immediately and a re-run
+    # under the SAME backend + kernel sources skips them (tunnel-wedge
+    # recovery, same story as tune_probe_block.py)
+    ckpt_path = os.path.join(
+        "/tmp", f"tune_chunk_rows.{backend}.u{os.getuid()}.partial.json")
+    table: dict = {}
+    timings: dict = {}
+    try:
+        with open(ckpt_path) as f:
+            prior = json.load(f)
+        if prior.get("backend") == backend and prior.get("kernel_sha") == sha:
+            table = prior.get("table", {})
+            timings = prior.get("timings", {})
+            print(f"resuming: {len(table)} buckets from checkpoint",
+                  file=sys.stderr)
+    except (OSError, ValueError):
+        pass
+
+    warned = []
+
+    def flush_ckpt():
+        try:
+            with open(ckpt_path + ".tmp", "w") as f:
+                json.dump({"backend": backend, "kernel_sha": sha,
+                           "table": table, "timings": timings}, f)
+            os.replace(ckpt_path + ".tmp", ckpt_path)
+        except OSError as e:
+            if not warned:
+                warned.append(True)
+                print(f"WARN: checkpoint flush failing ({e}); a mid-run "
+                      f"kill will lose progress", file=sys.stderr)
+
+    rng = np.random.default_rng(0)
+    for dim in dims:
+        x = rng.standard_normal((rows, dim)).astype(np.float32)
+        for family in ("ivf_flat", "ivf_pq"):
+            key = bucket_key(family, dim)
+            if key in table:
+                continue
+            best_c, best_t, curve = None, float("inf"), {}
+            for cr in CANDIDATES:
+                if cr > rows:
+                    continue
+                t = _time(_stream_fn(family, x, cr))
+                curve[str(cr)] = t
+                if t < best_t:
+                    best_c, best_t = cr, t
+            table[key] = best_c
+            timings[key] = {"rows": rows, "dim": dim, "n_lists": N_LISTS,
+                            "curve_s": curve}
+            flush_ckpt()
+            print(f"{family:9s} dim={dim:4d} → chunk_rows={best_c} "
+                  f"({rows / best_t:,.0f} rows/s)")
+        del x
+
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                       "raft_tpu", "neighbors", "_chunk_rows_table.json")
+    if backend != "tpu" and "--force" not in sys.argv:
+        # an off-TPU run must never clobber the table the TPU build
+        # paths consult (same rule as the probe_block tuner)
+        out = out.replace(".json", f".{backend}.json")
+        print(f"non-TPU backend: writing to {os.path.basename(out)} "
+              f"(--force overrides)", file=sys.stderr)
+    with open(out, "w") as f:
+        json.dump(table, f, indent=1, sort_keys=True)
+
+    import datetime
+
+    with open(out.replace(".json", ".meta.json"), "w") as f:
+        json.dump({"backend": backend,
+                   "date": datetime.date.today().isoformat(),
+                   "kernel_sha": sha,
+                   "rows": rows,
+                   "n_entries": len(table)}, f)
+        f.write("\n")
+    try:
+        os.remove(ckpt_path)  # spent: the final table supersedes it
+    except OSError:
+        pass
+    print(f"wrote {len(table)} entries → {os.path.normpath(out)}")
+    # the auto path must be able to see what we just measured
+    r = resolve_chunk_rows(0, 10 ** 9, dims[0], "ivf_flat")
+    assert r >= 1
+
+
+if __name__ == "__main__":
+    main()

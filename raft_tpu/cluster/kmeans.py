@@ -315,23 +315,38 @@ def _assign_balanced(x, c, counts, penalty, n_per,
     return labels, real
 
 
-def _capped_assign_impl(x, centroids, room):
+def _capped_assign_impl(x, centroids, room, valid=None):
     """Shared core of :func:`capped_assign` / :func:`capped_assign_room`:
-    ``room`` is a traced per-cluster capacity vector (k,) int32."""
+    ``room`` is a traced per-cluster capacity vector (k,) int32.
+
+    ``valid``: optional (n,) bool row mask — invalid rows never request a
+    cluster, never consume capacity, and keep label −1 (the pipelined
+    chunked builds pad the tail chunk to a fixed shape and mask the pads
+    here).  With ``valid=None`` (or all-True) the computation is
+    bit-identical to the unmasked form: masked rows only ever add
+    +inf-distance requests, which :func:`~raft_tpu.utils.segment.
+    within_group_rank` ranks after every finite (real) request, so real
+    rows' ranks — and therefore acceptance — are unchanged.
+    """
     n = x.shape[0]
     k = centroids.shape[0]
     d2 = sq_l2(x, centroids)
     INF = jnp.float32(jnp.inf)
+    if valid is None:
+        valid = jnp.ones((n,), bool)
+
+    def pending(labels):
+        return jnp.sum(((labels < 0) & valid).astype(jnp.int32))
 
     def cond(carry):
         labels, counts, prev_left = carry
-        left = jnp.sum((labels < 0).astype(jnp.int32))
+        left = pending(labels)
         return (left > 0) & (left != prev_left)
 
     def round_fn(carry):
         labels, counts, _ = carry
-        prev_left = jnp.sum((labels < 0).astype(jnp.int32))
-        unassigned = labels < 0
+        prev_left = pending(labels)
+        unassigned = (labels < 0) & valid
         full = counts >= room
         cost = jnp.where(full[None, :], INF, d2)
         cand = jnp.argmin(cost, axis=1).astype(jnp.int32)
@@ -374,12 +389,15 @@ def capped_assign(x, centroids, cap: int):
 
 
 @jax.jit
-def capped_assign_room(x, centroids, room):
+def capped_assign_room(x, centroids, room, valid=None):
     """:func:`capped_assign` against a traced per-cluster ``room`` vector
     (k,) — the streaming-build variant: chunked index builds pass the
     *remaining* capacity of each list (``cap - counts_so_far``) so a chunk
-    can never overflow lists filled by earlier chunks."""
-    return _capped_assign_impl(x, centroids, jnp.asarray(room, jnp.int32))
+    can never overflow lists filled by earlier chunks.  ``valid``: optional
+    (n,) bool row mask (padded fixed-shape chunks); masked rows keep
+    label −1 and consume no capacity."""
+    return _capped_assign_impl(x, centroids, jnp.asarray(room, jnp.int32),
+                               valid)
 
 
 @partial(jax.jit, static_argnames=("k", "max_iter", "cap", "precision"))

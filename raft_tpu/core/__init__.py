@@ -35,6 +35,7 @@ from .serialize import (
     load_arrays,
 )
 from .trace_guard import TraceGuard, SteadyStateError
+from .double_buffer import device_prefetch
 from . import interruptible, tracing, logging
 
 __all__ = [
@@ -53,5 +54,6 @@ __all__ = [
     "serialize_mdspan", "deserialize_mdspan", "serialize_scalar", "deserialize_scalar",
     "save_arrays", "load_arrays",
     "TraceGuard", "SteadyStateError",
+    "device_prefetch",
     "interruptible", "tracing", "logging",
 ]

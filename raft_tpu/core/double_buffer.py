@@ -1,0 +1,59 @@
+"""Host→device double buffering for streaming pipelines.
+
+The streaming index builds (``neighbors.*.build_chunked``) consume a
+sequence of host chunks.  Feeding them naively puts the H2D copy on the
+critical path: the device sits idle while chunk t+1 is copied in.  JAX's
+``jax.device_put`` is *asynchronous* — it returns a handle immediately
+and the copy proceeds in the background (TPU-KNN's overlapped-transfer
+model, PAPERS.md) — so issuing the put for chunk t+1 while the device
+computes on chunk t takes the copy off the critical path entirely.
+
+:func:`device_prefetch` is the one shared home of that pattern: it maps a
+staging function (typically ending in ``jax.device_put``) over an
+iterable, keeping ``depth`` staged items in flight ahead of the consumer.
+``device_put`` is an *explicit* transfer, so pipelines fed this way stay
+clean under ``jax.transfer_guard("disallow")`` (:class:`.TraceGuard`).
+
+On the CPU backend the transfer is zero-copy and the overlap is free but
+empty; on TPU it hides the PCIe/DMA latency of each chunk.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable, Iterable, Iterator, TypeVar
+
+__all__ = ["device_prefetch"]
+
+T = TypeVar("T")
+S = TypeVar("S")
+
+
+def device_prefetch(items: Iterable[T], stage: Callable[[T], S],
+                    depth: int = 1) -> Iterator[S]:
+    """Yield ``stage(item)`` for each item, staying ``depth`` staged items
+    ahead of the consumer.
+
+    ``stage`` runs on the consumer thread (no locking needed) but is
+    called for item t+1 *before* the consumer's loop body runs for item
+    t — with an async ``jax.device_put`` inside ``stage``, the H2D copy
+    of the next chunk overlaps the device compute on the current one.
+
+    ``depth=1`` (classic double buffering) is right for the build loops:
+    deeper pipelines only add host-memory pressure unless the producer
+    is bursty.
+    """
+    if depth < 1:
+        raise ValueError(f"depth must be >= 1, got {depth}")
+    it = iter(items)
+    buf: deque = deque()
+    exhausted = False
+    while True:
+        while not exhausted and len(buf) < depth + 1:
+            try:
+                buf.append(stage(next(it)))
+            except StopIteration:
+                exhausted = True
+        if not buf:
+            return
+        yield buf.popleft()

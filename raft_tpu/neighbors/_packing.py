@@ -22,9 +22,13 @@ import jax.numpy as jnp
 
 __all__ = ["pack_lists", "chunked_queries", "chunked_filtered_queries",
            "check_filter_covers_ids", "keep_lookup", "scatter_append",
-           "scatter_append_copy", "shard_rows", "sharded_train_sizes",
+           "scatter_append_copy", "device_full", "shard_rows",
+           "sharded_train_sizes",
            "as_keep_mask", "sentinel_filtered_ids", "prefetch_chunks",
-           "blocked_probe_plan", "resolve_probe_block"]
+           "prefetch_chunks_padded", "build_heartbeat",
+           "chunked_shard_rows", "chunked_shard_trainsets",
+           "blocked_probe_plan", "resolve_probe_block",
+           "resolve_chunk_rows"]
 
 
 def prefetch_chunks(dataset, chunk_rows: int, ids=None):
@@ -59,6 +63,84 @@ def prefetch_chunks(dataset, chunk_rows: int, ids=None):
             future = (pool.submit(read, *bounds[i + 1])
                       if i + 1 < len(bounds) else None)
             yield lo, hi, cur[0], cur[1]
+
+
+def prefetch_chunks_padded(dataset, chunk_rows: int, ids=None, *,
+                           dtype=None, sharding=None):
+    """Fixed-shape, double-buffered *device* feeding for the pipelined
+    streaming builds: :func:`prefetch_chunks` (background host reads) with
+    two pipeline stages on top —
+
+    * the tail chunk is padded up to ``chunk_rows`` rows with id −1, so
+      every chunk has the SAME shape and one jitted chunk-step executable
+      serves the whole stream (zero steady-state recompiles; the fused
+      steps mask ``idc < 0`` rows out of assignment and capacity);
+    * each chunk is staged onto the device with a non-blocking
+      ``jax.device_put`` issued one chunk AHEAD of the consumer
+      (:func:`raft_tpu.core.device_prefetch`), so the H2D copy of chunk
+      t+1 overlaps the device compute on chunk t.
+
+    Yields ``(lo, hi, xc_dev, idc_dev)`` with ``xc_dev: [chunk_rows, d]``
+    and ``idc_dev: [chunk_rows] int32``; ``hi − lo`` is the REAL row count
+    (< ``chunk_rows`` only for a padded tail).  ``dtype``: optional cast
+    applied host-side (before the put).  ``sharding``: optional
+    ``jax.sharding.Sharding`` for the put — the sharded builds pass
+    ``NamedSharding(mesh, P(axis))`` so each device receives only its row
+    slice (``chunk_rows`` must then divide by the axis size).
+
+    ``device_put`` is an explicit transfer: consumers stay clean under
+    ``jax.transfer_guard("disallow")``.
+    """
+    import numpy as np
+
+    from ..core.double_buffer import device_prefetch
+
+    n = dataset.shape[0]
+    chunk_rows = max(1, min(int(chunk_rows), n))
+
+    def stage(item):
+        lo, hi, xc_h, idc_h = item
+        xc_h = np.asarray(xc_h)
+        if dtype is not None:
+            xc_h = xc_h.astype(dtype, copy=False)
+        idc_h = np.asarray(idc_h, np.int32)
+        rows = hi - lo
+        if rows < chunk_rows:  # pad the tail to the one fixed shape
+            xp = np.zeros((chunk_rows,) + xc_h.shape[1:], xc_h.dtype)
+            xp[:rows] = xc_h
+            ip = np.full((chunk_rows,), -1, np.int32)
+            ip[:rows] = idc_h
+            xc_h, idc_h = xp, ip
+        return (lo, hi, jax.device_put(xc_h, sharding),
+                jax.device_put(idc_h, sharding))
+
+    yield from device_prefetch(prefetch_chunks(dataset, chunk_rows, ids),
+                               stage)
+
+
+def build_heartbeat(tag: str, total_rows: int):
+    """Liveness reporter for multi-hour streaming builds: returns a
+    ``tick(rows_done)`` closure that debug-logs CUMULATIVE throughput
+    (rows/s) and the ETA to completion, not just the row range
+    (``RAFT_TPU_LOG_LEVEL=DEBUG``).  Pure host arithmetic on the dispatch
+    side — never syncs the device (with async dispatch the rate reads as
+    dispatch throughput, which converges to device throughput once the
+    pipeline fills)."""
+    import time
+
+    from ..core.logging import default_logger
+
+    logger = default_logger()
+    t0 = time.perf_counter()
+
+    def tick(rows_done: int) -> None:
+        dt = max(time.perf_counter() - t0, 1e-9)
+        rate = rows_done / dt
+        eta = (total_rows - rows_done) / max(rate, 1e-9)
+        logger.debug("%s: %d/%d rows (%.0f rows/s, ETA %.0fs)",
+                     tag, rows_done, total_rows, rate, eta)
+
+    return tick
 
 
 def as_keep_mask(filter, n=None, nq=None):
@@ -252,6 +334,47 @@ def resolve_probe_block(requested: int, n_probes: int, cap: int,
     return hit
 
 
+@lru_cache(maxsize=1)
+def _chunk_rows_table():
+    """Measured chunk_rows table written by ``bench/tune_chunk_rows.py``
+    (same offline-tuned-dispatch pattern as ``_probe_block_table``).
+    Canonical name first; a ``.{backend}.json`` suffix holds off-TPU
+    measurements without clobbering the TPU table."""
+    import json
+    import os
+
+    base = os.path.join(os.path.dirname(__file__), "_chunk_rows_table")
+    for suffix in (".json", f".{jax.default_backend()}.json"):
+        try:
+            with open(base + suffix) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            continue
+    return {}
+
+
+#: fallback streaming chunk size when no measured table entry exists —
+#: the historical ``build_chunked`` default
+DEFAULT_CHUNK_ROWS = 65536
+
+
+def resolve_chunk_rows(requested: int, n: int, dim: int, family: str) -> int:
+    """Static chunk size for a streaming (``build_chunked``) index build.
+
+    ``requested > 0`` wins (clamped to ``[1, n]``); ``0`` = auto: the
+    measured table (log2-bucketed by dim, written by
+    ``bench/tune_chunk_rows.py``), else :data:`DEFAULT_CHUNK_ROWS`.
+    Results are identical for every value — chunk size is a pure
+    throughput knob (docs/tuning_guide.md) — so auto never changes what
+    gets built, only how fast.  Pure host-int arithmetic."""
+    if requested:
+        return max(1, min(int(requested), max(1, int(n))))
+    entry = _chunk_rows_table().get(f"{family}:{int(dim).bit_length()}")
+    if entry is None:
+        entry = DEFAULT_CHUNK_ROWS
+    return max(1, min(int(entry), max(1, int(n))))
+
+
 def sentinel_filtered_ids(vals, ids):
     """Filtered-search output contract: slots that hold no real survivor
     (±inf distance) report id −1, never a filtered row's id."""
@@ -299,6 +422,48 @@ def sharded_train_sizes(per: int, n_lists_local: int, trainset_fraction: float,
     n_train = min(per, max(n_lists_local * 32, int(per * trainset_fraction)))
     bal_cap = max(1, -(-int(balanced_max_ratio * n_train) // n_lists_local))
     return n_train, bal_cap
+
+
+def chunked_shard_rows(n: int, chunk_rows: int, n_dev: int):
+    """Per-shard REAL row counts under the chunk-striped layout of the
+    sharded streaming builds: every chunk of ``chunk_rows`` rows (the last
+    one padded) splits contiguously over the ``n_dev`` mesh devices, so
+    shard ``s`` owns rows ``[t·C + s·C/S, t·C + (s+1)·C/S)`` of every
+    chunk ``t``.  Returns an ``(n_dev,)`` numpy int array — used to size
+    per-shard list capacity and to validate no shard streams zero rows."""
+    import numpy as np
+
+    pc = chunk_rows // n_dev
+    n_chunks = -(-n // chunk_rows)
+    starts = (np.arange(n_chunks)[:, None] * chunk_rows
+              + np.arange(n_dev)[None, :] * pc)
+    return np.clip(n - starts, 0, pc).sum(axis=0)
+
+
+def chunked_shard_trainsets(dataset, n: int, chunk_rows: int, n_dev: int,
+                            n_train: int, seed: int):
+    """Host-sampled per-shard quantizer trainsets for the sharded
+    streaming builds: shard ``s`` trains on rows sampled from ITS OWN
+    chunk stripes (:func:`chunked_shard_rows` layout), so each shard's
+    coarse quantizer models exactly the rows that will stream through it.
+    Returns ``[n_dev, n_train, d]`` numpy (shards with fewer than
+    ``n_train`` real rows sample with replacement — shapes must be static
+    across the mesh).  Reads are sorted per shard (memmap-friendly)."""
+    import numpy as np
+
+    pc = chunk_rows // n_dev
+    n_chunks = -(-n // chunk_rows)
+    rng = np.random.default_rng(seed)
+    out = []
+    for s in range(n_dev):
+        starts = np.arange(n_chunks) * chunk_rows + s * pc
+        avail = np.clip(n - starts, 0, pc)
+        total = int(avail.sum())
+        pos = np.sort(rng.choice(total, n_train, replace=total < n_train))
+        cum = np.cumsum(avail) - avail
+        ci = np.searchsorted(cum, pos, side="right") - 1
+        out.append(np.asarray(dataset[starts[ci] + (pos - cum[ci])]))
+    return np.stack(out)
 
 
 def chunked_queries(run, q, chunk: int, aux=None):
@@ -441,3 +606,13 @@ scatter_append = partial(jax.jit, static_argnames=("n_lists", "cap"),
                          donate_argnums=(0, 1))(_scatter_append_impl)
 scatter_append_copy = partial(jax.jit, static_argnames=("n_lists", "cap"))(
     _scatter_append_impl)
+
+
+@partial(jax.jit, static_argnames=("shape", "fill", "dtype"))
+def device_full(shape, fill, dtype):
+    """Allocate a filled device buffer via a compiled program rather than
+    an eager ``jnp.full`` — eager fill broadcasts a HOST scalar, an
+    implicit H2D transfer that trips ``jax.transfer_guard("disallow")``
+    (:class:`~raft_tpu.core.TraceGuard`).  Used for the streaming builds'
+    slab initialisation so the whole build is guard-clean."""
+    return jnp.full(shape, fill, dtype)

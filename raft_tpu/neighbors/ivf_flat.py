@@ -50,6 +50,7 @@ __all__ = [
     "IvfFlatIndex",
     "build",
     "build_chunked",
+    "build_chunked_sharded",
     "search",
     "searcher",
     "extend",
@@ -151,49 +152,72 @@ def _train_subsample(n: int, n_train: int, seed: int):
     return np.sort(rs.choice(n, n_train, replace=False))
 
 
-def build_chunked(dataset, params: Optional[IvfFlatIndexParams] = None, *,
-                  chunk_rows: int = 65536, source_ids=None,
-                  res=None) -> IvfFlatIndex:
-    """Out-of-core build: the dataset stays on host (any numpy-indexable —
-    ``np.ndarray``, ``np.memmap``, an ``io.BatchLoader``-backed array) and
-    streams through the device in fixed-size chunks.
-
-    Device peak = list slabs + one chunk + one (chunk, n_lists) distance
-    block — never the whole dataset (the r2 builds were whole-dataset-
-    resident; VERDICT r2 missing #2).  Pipeline per chunk: capacity-capped
-    assignment against *remaining* room
-    (:func:`~raft_tpu.cluster.kmeans.capped_assign_room`), then a donated
-    in-place :func:`~._packing.scatter_append` into the slabs — the same
-    layout :func:`build` produces in one shot.
-
-    Reference analog: the SNMG streaming/batch build model
-    (``core/device_resources_snmg.hpp:36``) without a CUDA ancestor for the
-    chunk loop itself (cuVS migration).
-    """
-    from ._packing import prefetch_chunks, scatter_append
-    from ..cluster.kmeans import capped_assign_room
-
-    p = params or IvfFlatIndexParams()
-    n, d = dataset.shape
-    expects(p.n_lists >= 1 and p.n_lists <= n, "n_lists out of range")
-    cap = max(1, int(np.ceil(p.list_cap_ratio * n / p.n_lists)))
-    dtype = jnp.asarray(np.asarray(dataset[:1])).dtype
-
-    # 1. train the coarse quantizer on a host-sampled subset
+def _coarse_train_chunked(dataset, p: IvfFlatIndexParams, n: int):
+    """Coarse-quantizer training for the streaming builds: balanced kmeans
+    over a host-sampled subset (the only phase that touches more than one
+    chunk of host data at a time)."""
     n_train = min(n, max(p.n_lists * 4, int(n * p.kmeans_trainset_fraction)))
     sel = _train_subsample(n, n_train, p.seed)
     kp = KMeansParams(n_clusters=p.n_lists, max_iter=p.kmeans_n_iters,
                       seed=p.seed)
     centroids, _, _ = kmeans_balanced_fit(np.asarray(dataset[sel]), kp)
+    return centroids
 
-    # 2. stream chunks (next host read prefetched on a background thread
-    # while the device consumes the current one): capped assign against
-    # remaining room, donated scatter-append
-    data = jnp.zeros((p.n_lists, cap, d), dtype)
+
+@partial(jax.jit, static_argnames=("n_lists", "cap"), donate_argnums=(0, 1))
+def _flat_chunk_step(slabs, counts, centroids, xc, idc, *,
+                     n_lists: int, cap: int):
+    """ONE jitted, slab-donating program per chunk: masked capped
+    assignment against remaining room + scatter-append, fused so XLA sees
+    (and schedules) the whole chunk as a single dispatch — no host
+    round-trip for ``counts`` between the stages.  Pad rows (``idc < 0``,
+    from the fixed-shape tail padding) never request a list, never consume
+    capacity, and scatter-drop via label −1, so the padded stream is
+    bit-identical to the unpadded per-op loop."""
+    from ..cluster.kmeans import _capped_assign_impl
+    from ._packing import _scatter_append_impl
+
+    valid = idc >= 0
+    labels, _ = _capped_assign_impl(xc, centroids, cap - counts, valid)
+    return _scatter_append_impl(slabs, counts, labels, (xc, idc),
+                                n_lists=n_lists, cap=cap)
+
+
+def _stream_pipelined(dataset, centroids, p: IvfFlatIndexParams, n: int,
+                      cap: int, chunk_rows: int, source_ids, dtype,
+                      heartbeat=None):
+    """Pipelined chunk engine: fixed-shape double-buffered device staging
+    (:func:`~._packing.prefetch_chunks_padded`) feeding the fused donated
+    :func:`_flat_chunk_step` — one executable, one dispatch per chunk."""
+    from ._packing import device_full, prefetch_chunks_padded
+
+    d = dataset.shape[1]
+    data = device_full((p.n_lists, cap, d), 0, dtype)
+    ids_slab = device_full((p.n_lists, cap), -1, jnp.int32)
+    counts = device_full((p.n_lists,), 0, jnp.int32)
+    for lo, hi, xc, idc in prefetch_chunks_padded(dataset, chunk_rows,
+                                                  source_ids, dtype=dtype):
+        (data, ids_slab), counts = _flat_chunk_step(
+            (data, ids_slab), counts, centroids, xc, idc,
+            n_lists=p.n_lists, cap=cap)
+        if heartbeat is not None:
+            heartbeat(hi)
+    return data, ids_slab, counts
+
+
+def _stream_perop(dataset, centroids, p: IvfFlatIndexParams, n: int,
+                  cap: int, chunk_rows: int, source_ids, dtype):
+    """Reference per-op chunk loop (the pre-pipelining engine): blocking
+    H2D ``jnp.asarray``, separate assign / scatter dispatches, tail chunk
+    at its own shape.  Kept verbatim as the bit-parity oracle for the
+    fused engine (tests/test_chunked_builds.py) and the A/B baseline of
+    ``bench/build_throughput.py``."""
+    from ..cluster.kmeans import capped_assign_room
+    from ._packing import prefetch_chunks, scatter_append
+
+    data = jnp.zeros((p.n_lists, cap, dataset.shape[1]), dtype)
     ids_slab = jnp.full((p.n_lists, cap), -1, jnp.int32)
     counts = jnp.zeros((p.n_lists,), jnp.int32)
-    from ..core.logging import default_logger
-
     for lo, hi, xc_h, idc_h in prefetch_chunks(dataset, chunk_rows,
                                                source_ids):
         xc = jnp.asarray(xc_h, dtype)
@@ -202,10 +226,71 @@ def build_chunked(dataset, params: Optional[IvfFlatIndexParams] = None, *,
         (data, ids_slab), counts = scatter_append(
             (data, ids_slab), counts, labels, (xc, idc),
             n_lists=p.n_lists, cap=cap)
-        # liveness signal for multi-hour full-scale builds
-        # (RAFT_TPU_LOG_LEVEL=DEBUG)
-        default_logger().debug("build_chunked: rows %d-%d of %d ingested",
-                               lo, hi, n)
+    return data, ids_slab, counts
+
+
+def build_chunked(dataset, params: Optional[IvfFlatIndexParams] = None, *,
+                  chunk_rows: int = 0, source_ids=None,
+                  res=None) -> IvfFlatIndex:
+    """Out-of-core build: the dataset stays on host (any numpy-indexable —
+    ``np.ndarray``, ``np.memmap``, an ``io.BatchLoader``-backed array) and
+    streams through the device in fixed-size chunks.
+
+    Device peak = list slabs + two staged chunks + one (chunk, n_lists)
+    distance block — never the whole dataset (the r2 builds were
+    whole-dataset-resident; VERDICT r2 missing #2).  The chunk engine is
+    pipelined: each chunk is ONE jitted, slab-donating program
+    (:func:`_flat_chunk_step` — capped assign against remaining room fused
+    with the scatter-append), the tail chunk is padded to ``chunk_rows``
+    with masked rows so a single executable serves the whole stream (zero
+    steady-state recompiles, assertable under
+    :class:`~raft_tpu.core.TraceGuard`), and chunk t+1 is staged
+    host→device with a non-blocking ``device_put`` while chunk t computes
+    (:func:`~raft_tpu.core.device_prefetch`).
+
+    ``chunk_rows=0`` (default) = auto: the measured table written by
+    ``bench/tune_chunk_rows.py``, else 65536
+    (:func:`~._packing.resolve_chunk_rows`) — a pure throughput knob, the
+    built index is identical for every value.
+
+    Reference analog: the SNMG streaming/batch build model
+    (``core/device_resources_snmg.hpp:36``) without a CUDA ancestor for the
+    chunk loop itself (cuVS migration).
+    """
+    from ._packing import build_heartbeat, resolve_chunk_rows
+
+    p = params or IvfFlatIndexParams()
+    n, d = dataset.shape
+    expects(p.n_lists >= 1 and p.n_lists <= n, "n_lists out of range")
+    cap = max(1, int(np.ceil(p.list_cap_ratio * n / p.n_lists)))
+    dtype = jnp.asarray(np.asarray(dataset[:1])).dtype
+    chunk_rows = resolve_chunk_rows(chunk_rows, n, d, "ivf_flat")
+
+    centroids = _coarse_train_chunked(dataset, p, n)
+    data, ids_slab, counts = _stream_pipelined(
+        dataset, centroids, p, n, cap, chunk_rows, source_ids, dtype,
+        heartbeat=build_heartbeat("ivf_flat.build_chunked", n))
+    norms = jnp.sum(data.astype(jnp.float32) ** 2, axis=2)
+    return IvfFlatIndex(centroids, data, ids_slab, counts, norms, p.metric)
+
+
+def _build_chunked_perop(dataset, params: Optional[IvfFlatIndexParams] = None,
+                         *, chunk_rows: int = 0,
+                         source_ids=None) -> IvfFlatIndex:
+    """:func:`build_chunked` on the reference per-op chunk loop
+    (:func:`_stream_perop`) — the parity oracle / A/B baseline; not part
+    of the public API."""
+    from ._packing import resolve_chunk_rows
+
+    p = params or IvfFlatIndexParams()
+    n, d = dataset.shape
+    expects(p.n_lists >= 1 and p.n_lists <= n, "n_lists out of range")
+    cap = max(1, int(np.ceil(p.list_cap_ratio * n / p.n_lists)))
+    dtype = jnp.asarray(np.asarray(dataset[:1])).dtype
+    chunk_rows = resolve_chunk_rows(chunk_rows, n, d, "ivf_flat")
+    centroids = _coarse_train_chunked(dataset, p, n)
+    data, ids_slab, counts = _stream_perop(
+        dataset, centroids, p, n, cap, chunk_rows, source_ids, dtype)
     norms = jnp.sum(data.astype(jnp.float32) ** 2, axis=2)
     return IvfFlatIndex(centroids, data, ids_slab, counts, norms, p.metric)
 
@@ -456,6 +541,118 @@ def build_sharded(dataset, mesh: Mesh, params: Optional[IvfFlatIndexParams] = No
         p.kmeans_n_iters, float(kp.balanced_penalty), bal_cap, p.seed)
     c, data, ids, counts, norms = prog(x_sh)
     return IvfFlatIndex(c, data, ids, counts, norms, p.metric)
+
+
+@lru_cache(maxsize=16)
+def _sharded_chunk_train_program(mesh: Mesh, axis: str, n_lists_local: int,
+                                 max_iter: int, penalty: float, bal_cap: int,
+                                 seed: int):
+    """Per-shard coarse-quantizer fit for the sharded streaming build:
+    each device balanced-fits ITS local centroids on ITS host-sampled
+    trainset stripe (``[S·n_train, d]`` sharded in) — one shard_map
+    program, S parallel fits, one compile."""
+    from ..cluster.kmeans import _balanced_fit_impl
+
+    def local(xt_l):
+        shard = jax.lax.axis_index(axis)
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), shard)
+        c, _, _, _ = _balanced_fit_impl(
+            xt_l, key, n_lists_local, max_iter, penalty, bal_cap)
+        return c
+
+    return jax.jit(shard_map(
+        local, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+        check_vma=False))
+
+
+@lru_cache(maxsize=16)
+def _sharded_chunk_step_program(mesh: Mesh, axis: str, n_lists_local: int,
+                                cap: int):
+    """Data-parallel fused chunk step: every device runs
+    :func:`_flat_chunk_step`'s body on ITS slice of the chunk against ITS
+    local lists — one jitted shard_map program per chunk, slabs donated,
+    zero cross-device data movement (rows only ever land in the lists of
+    the shard they streamed through)."""
+    from ..cluster.kmeans import _capped_assign_impl
+    from ._packing import _scatter_append_impl
+
+    def local(data_l, ids_l, counts_l, c_l, xc_l, idc_l):
+        valid = idc_l >= 0
+        labels, _ = _capped_assign_impl(xc_l, c_l, cap - counts_l, valid)
+        (data_l, ids_l), counts_l = _scatter_append_impl(
+            (data_l, ids_l), counts_l, labels, (xc_l, idc_l),
+            n_lists=n_lists_local, cap=cap)
+        return data_l, ids_l, counts_l
+
+    return jax.jit(shard_map(
+        local, mesh=mesh, in_specs=(P(axis),) * 6, out_specs=(P(axis),) * 3,
+        check_vma=False), donate_argnums=(0, 1, 2))
+
+
+def build_chunked_sharded(dataset, mesh: Mesh,
+                          params: Optional[IvfFlatIndexParams] = None, *,
+                          chunk_rows: int = 0, source_ids=None,
+                          axis: str = "shard") -> IvfFlatIndex:
+    """Distributed streaming build — the build-side analog of
+    :func:`search_sharded`: the dataset stays on host and each fixed-size
+    chunk is split contiguously over the mesh axis (one sharded
+    ``device_put``, staged a chunk ahead), with every device appending its
+    slice into ITS OWN local lists via the fused donated chunk step.
+    Combines :func:`build_chunked`'s out-of-core pipeline (fixed shapes,
+    padded tail, single executable) with :func:`build_sharded`'s
+    shard-local sub-index model (device s owns lists
+    ``[s·L/S, (s+1)·L/S)`` trained on its own row stripes; ids are global
+    row positions; :func:`search_sharded` probes every shard and merges).
+    Per-device peak = local slabs + its chunk slice — corpora larger than
+    ONE chip's HBM stream through S chips in parallel."""
+    from jax.sharding import NamedSharding
+
+    from ._packing import (build_heartbeat, chunked_shard_rows,
+                           chunked_shard_trainsets, prefetch_chunks_padded,
+                           resolve_chunk_rows, sharded_train_sizes)
+
+    p = params or IvfFlatIndexParams()
+    n, d = dataset.shape
+    n_dev = int(mesh.shape[axis])
+    n_lists_local = max(1, (p.n_lists + n_dev - 1) // n_dev)
+    chunk_rows = resolve_chunk_rows(chunk_rows, n, d, "ivf_flat")
+    # chunks split evenly over the axis; never a chunk beyond one padded pass
+    chunk_rows = min(-(-chunk_rows // n_dev), -(-n // n_dev)) * n_dev
+    shard_valid = chunked_shard_rows(n, chunk_rows, n_dev)
+    expects(int(shard_valid.min()) >= 1,
+            f"chunk layout leaves a shard with no rows (n={n}, "
+            f"chunk_rows={chunk_rows}, shards={n_dev}): lower chunk_rows "
+            f"or use fewer shards")
+    per = int(shard_valid.max())
+    expects(n_lists_local <= per, "n_lists exceeds rows per shard")
+    cap = max(1, int(np.ceil(p.list_cap_ratio * per / n_lists_local)))
+    kp = KMeansParams()
+    n_train, bal_cap = sharded_train_sizes(
+        per, n_lists_local, p.kmeans_trainset_fraction, kp.balanced_max_ratio)
+    dtype = jnp.asarray(np.asarray(dataset[:1])).dtype
+    sharding = NamedSharding(mesh, P(axis))
+
+    xt = chunked_shard_trainsets(dataset, n, chunk_rows, n_dev, n_train,
+                                 p.seed)
+    xt_sh = jax.device_put(xt.reshape(n_dev * n_train, d), sharding)
+    train = _sharded_chunk_train_program(
+        mesh, axis, n_lists_local, p.kmeans_n_iters,
+        float(kp.balanced_penalty), bal_cap, p.seed)
+    centroids = train(xt_sh)
+
+    L = n_dev * n_lists_local
+    data = jax.device_put(jnp.zeros((L, cap, d), dtype), sharding)
+    ids_slab = jax.device_put(jnp.full((L, cap), -1, jnp.int32), sharding)
+    counts = jax.device_put(jnp.zeros((L,), jnp.int32), sharding)
+    step = _sharded_chunk_step_program(mesh, axis, n_lists_local, cap)
+    heartbeat = build_heartbeat("ivf_flat.build_chunked_sharded", n)
+    for lo, hi, xc, idc in prefetch_chunks_padded(
+            dataset, chunk_rows, source_ids, dtype=dtype, sharding=sharding):
+        data, ids_slab, counts = step(data, ids_slab, counts, centroids,
+                                      xc, idc)
+        heartbeat(hi)
+    norms = jnp.sum(data.astype(jnp.float32) ** 2, axis=2)
+    return IvfFlatIndex(centroids, data, ids_slab, counts, norms, p.metric)
 
 
 @partial(jax.jit, static_argnames=("k", "n_probes", "metric", "axis", "mesh",

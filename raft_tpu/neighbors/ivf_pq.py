@@ -69,6 +69,7 @@ __all__ = [
     "search",
     "searcher",
     "build_sharded",
+    "build_chunked_sharded",
     "search_sharded",
 ]
 
@@ -462,39 +463,11 @@ def extend(index: IvfPqIndex, new_vectors, new_ids=None) -> IvfPqIndex:
     return out.with_recon() if index.recon is not None else out
 
 
-def build_chunked(dataset, params: Optional[IvfPqIndexParams] = None, *,
-                  chunk_rows: int = 65536, source_ids=None,
-                  res=None) -> IvfPqIndex:
-    """Out-of-core build: the dataset stays on host (numpy-indexable —
-    ``np.ndarray``/``np.memmap``) and streams through the device in chunks.
-
-    Device peak = PQ slabs (``n·cap_ratio·pq_dim`` **bytes**, ~16× smaller
-    than the f32 dataset at the defaults) + one chunk + its (chunk, L)
-    distance block — a dataset larger than one chip's HBM is buildable as
-    long as its *codes* fit (VERDICT r2 missing #2).  Defaults to
-    ``store_recon=False`` semantics during the stream; call
-    ``index.with_recon()`` afterwards if the bf16 slab tier fits.
-
-    Per chunk: capacity-capped assignment against remaining room
-    (:func:`~raft_tpu.cluster.kmeans.capped_assign_room`), residual PQ
-    encoding, then a donated in-place
-    :func:`~._packing.scatter_append` of (codes, norms, ids).
-    """
-    from ..cluster.kmeans import capped_assign_room
-    from ._packing import prefetch_chunks, scatter_append
+def _pq_train_chunked(dataset, p: IvfPqIndexParams, n: int, m: int, c: int):
+    """Coarse quantizer + PQ codebooks from one host-sampled trainset —
+    the training phase shared by the pipelined and per-op chunk engines."""
     from .ivf_flat import _train_subsample
 
-    p = params or IvfPqIndexParams()
-    n, d = dataset.shape
-    m = p.pq_dim or max(1, d // 4)
-    expects(d % m == 0, f"dim {d} must divide by pq_dim {m}")
-    expects(4 <= p.pq_bits <= 8, "pq_bits must be in [4, 8]")
-    expects(not p.pack_codes or p.pq_bits <= 4,
-            "pack_codes requires pq_bits <= 4")
-    c = 1 << p.pq_bits
-    cap = max(1, int(np.ceil(p.list_cap_ratio * n / p.n_lists)))
-
-    # 1. coarse quantizer + PQ codebooks from one host-sampled trainset
     n_train = min(n, max(p.n_lists * 4, int(n * p.kmeans_trainset_fraction)))
     sel = _train_subsample(n, n_train, p.seed)
     xt = jnp.asarray(np.asarray(dataset[sel]))
@@ -505,15 +478,67 @@ def build_chunked(dataset, params: Optional[IvfPqIndexParams] = None, *,
     key = jax.random.PRNGKey(p.seed)
     codebooks = _train_codebooks(res_train, jax.random.fold_in(key, 7), m, c,
                                  p.pq_kmeans_n_iters)
+    return centroids, codebooks
 
-    # 2. stream chunks into the PQ slabs (next host read prefetched on a
-    # background thread while the device consumes the current one)
+
+@partial(jax.jit, static_argnames=("n_lists", "cap", "m"),
+         donate_argnums=(0, 1))
+def _pq_chunk_step(slabs, counts, centroids, codebooks, xc, idc, *,
+                   n_lists: int, cap: int, m: int):
+    """ONE jitted, slab-donating program per chunk: masked capped assign →
+    residual → PQ encode → scatter-append, fused so the whole chunk is a
+    single dispatch with no host round-trip for ``counts``.  Pad rows
+    (``idc < 0``) never request a list, never consume capacity, and
+    scatter-drop via label −1 — the padded fixed-shape stream is
+    bit-identical to the unpadded per-op loop."""
+    from ..cluster.kmeans import _capped_assign_impl
+    from ._packing import _scatter_append_impl
+
+    valid = idc >= 0
+    labels, _ = _capped_assign_impl(xc, centroids, cap - counts, valid)
+    residuals = xc - centroids[jnp.clip(labels, 0, n_lists - 1)]
+    ch_codes, ch_norms = _encode(residuals, codebooks, m)
+    return _scatter_append_impl(slabs, counts, labels,
+                                (ch_codes, ch_norms, idc),
+                                n_lists=n_lists, cap=cap)
+
+
+def _pq_stream_pipelined(dataset, centroids, codebooks,
+                         p: IvfPqIndexParams, n: int, m: int, cap: int,
+                         chunk_rows: int, source_ids, heartbeat=None):
+    """Pipelined chunk engine: fixed-shape double-buffered device staging
+    (:func:`~._packing.prefetch_chunks_padded`) feeding the fused donated
+    :func:`_pq_chunk_step` — one executable, one dispatch per chunk."""
+    from ._packing import device_full, prefetch_chunks_padded
+
+    codes = device_full((p.n_lists, cap, m), 0, jnp.uint8)
+    cnorms = device_full((p.n_lists, cap), 0, jnp.float32)
+    ids_slab = device_full((p.n_lists, cap), -1, jnp.int32)
+    counts = device_full((p.n_lists,), 0, jnp.int32)
+    for lo, hi, xc, idc in prefetch_chunks_padded(dataset, chunk_rows,
+                                                  source_ids):
+        (codes, cnorms, ids_slab), counts = _pq_chunk_step(
+            (codes, cnorms, ids_slab), counts, centroids, codebooks, xc,
+            idc, n_lists=p.n_lists, cap=cap, m=m)
+        if heartbeat is not None:
+            heartbeat(hi)
+    return codes, cnorms, ids_slab, counts
+
+
+def _pq_stream_perop(dataset, centroids, codebooks, p: IvfPqIndexParams,
+                     n: int, m: int, cap: int, chunk_rows: int, source_ids):
+    """Reference per-op chunk loop (the pre-pipelining engine): blocking
+    H2D ``jnp.asarray``, separate assign / residual / encode / scatter
+    dispatches, tail chunk at its own shape.  Kept verbatim as the
+    bit-parity oracle for the fused engine and the A/B baseline of
+    ``bench/build_throughput.py``."""
+    from ..cluster.kmeans import capped_assign_room
+    from ._packing import prefetch_chunks, scatter_append
+
     codes = jnp.zeros((p.n_lists, cap, m), jnp.uint8)
     cnorms = jnp.zeros((p.n_lists, cap), jnp.float32)
     ids_slab = jnp.full((p.n_lists, cap), -1, jnp.int32)
     counts = jnp.zeros((p.n_lists,), jnp.int32)
-    from ..core.logging import default_logger
-
     for lo, hi, xc_h, idc_h in prefetch_chunks(dataset, chunk_rows,
                                                source_ids):
         xc = jnp.asarray(xc_h)
@@ -524,14 +549,85 @@ def build_chunked(dataset, params: Optional[IvfPqIndexParams] = None, *,
         (codes, cnorms, ids_slab), counts = scatter_append(
             (codes, cnorms, ids_slab), counts, labels,
             (ch_codes, ch_norms, idc), n_lists=p.n_lists, cap=cap)
-        # multi-hour full-scale builds need a liveness signal
-        # (RAFT_TPU_LOG_LEVEL=DEBUG): rows ingested, not per-list detail
-        default_logger().debug("build_chunked: rows %d-%d of %d encoded",
-                               lo, hi, n)
+    return codes, cnorms, ids_slab, counts
+
+
+def build_chunked(dataset, params: Optional[IvfPqIndexParams] = None, *,
+                  chunk_rows: int = 0, source_ids=None,
+                  res=None) -> IvfPqIndex:
+    """Out-of-core build: the dataset stays on host (numpy-indexable —
+    ``np.ndarray``/``np.memmap``) and streams through the device in chunks.
+
+    Device peak = PQ slabs (``n·cap_ratio·pq_dim`` **bytes**, ~16× smaller
+    than the f32 dataset at the defaults) + two staged chunks + one
+    (chunk, L) distance block — a dataset larger than one chip's HBM is
+    buildable as long as its *codes* fit (VERDICT r2 missing #2).
+    Defaults to ``store_recon=False`` semantics during the stream; call
+    ``index.with_recon()`` afterwards if the bf16 slab tier fits.
+
+    The chunk engine is pipelined: each chunk is ONE jitted,
+    slab-donating program (:func:`_pq_chunk_step` — capped assign against
+    remaining room → residual → PQ encode → scatter-append, fused), the
+    tail chunk is padded to ``chunk_rows`` with masked rows so a single
+    executable serves the whole stream (zero steady-state recompiles,
+    assertable under :class:`~raft_tpu.core.TraceGuard`), and chunk t+1
+    is staged host→device with a non-blocking ``device_put`` while chunk
+    t computes (:func:`~raft_tpu.core.device_prefetch`).
+
+    ``chunk_rows=0`` (default) = auto: the measured table written by
+    ``bench/tune_chunk_rows.py``, else 65536
+    (:func:`~._packing.resolve_chunk_rows`) — a pure throughput knob, the
+    built index is identical for every value.
+    """
+    from ._packing import build_heartbeat, resolve_chunk_rows
+
+    p = params or IvfPqIndexParams()
+    n, d = dataset.shape
+    m = p.pq_dim or max(1, d // 4)
+    expects(d % m == 0, f"dim {d} must divide by pq_dim {m}")
+    expects(4 <= p.pq_bits <= 8, "pq_bits must be in [4, 8]")
+    expects(not p.pack_codes or p.pq_bits <= 4,
+            "pack_codes requires pq_bits <= 4")
+    c = 1 << p.pq_bits
+    cap = max(1, int(np.ceil(p.list_cap_ratio * n / p.n_lists)))
+    chunk_rows = resolve_chunk_rows(chunk_rows, n, d, "ivf_pq")
+
+    centroids, codebooks = _pq_train_chunked(dataset, p, n, m, c)
+    codes, cnorms, ids_slab, counts = _pq_stream_pipelined(
+        dataset, centroids, codebooks, p, n, m, cap, chunk_rows, source_ids,
+        heartbeat=build_heartbeat("ivf_pq.build_chunked", n))
 
     index = IvfPqIndex(centroids, codebooks, codes, cnorms, ids_slab,
                        counts, p.metric)
     index = index.with_adc_luts()  # hoisted-ADC tables, while codes are unpacked
+    index = index.with_recon() if p.store_recon else index
+    return index.with_packed_codes() if p.pack_codes else index
+
+
+def _build_chunked_perop(dataset, params: Optional[IvfPqIndexParams] = None,
+                         *, chunk_rows: int = 0,
+                         source_ids=None) -> IvfPqIndex:
+    """:func:`build_chunked` on the reference per-op chunk loop
+    (:func:`_pq_stream_perop`) — the parity oracle / A/B baseline; not
+    part of the public API."""
+    from ._packing import resolve_chunk_rows
+
+    p = params or IvfPqIndexParams()
+    n, d = dataset.shape
+    m = p.pq_dim or max(1, d // 4)
+    expects(d % m == 0, f"dim {d} must divide by pq_dim {m}")
+    expects(4 <= p.pq_bits <= 8, "pq_bits must be in [4, 8]")
+    expects(not p.pack_codes or p.pq_bits <= 4,
+            "pack_codes requires pq_bits <= 4")
+    c = 1 << p.pq_bits
+    cap = max(1, int(np.ceil(p.list_cap_ratio * n / p.n_lists)))
+    chunk_rows = resolve_chunk_rows(chunk_rows, n, d, "ivf_pq")
+    centroids, codebooks = _pq_train_chunked(dataset, p, n, m, c)
+    codes, cnorms, ids_slab, counts = _pq_stream_perop(
+        dataset, centroids, codebooks, p, n, m, cap, chunk_rows, source_ids)
+    index = IvfPqIndex(centroids, codebooks, codes, cnorms, ids_slab,
+                       counts, p.metric)
+    index = index.with_adc_luts()
     index = index.with_recon() if p.store_recon else index
     return index.with_packed_codes() if p.pack_codes else index
 
@@ -897,6 +993,169 @@ def build_sharded(dataset, mesh, params: Optional[IvfPqIndexParams] = None,
         centroid_lut=clut, adc_norms=anorms,
     )
     # packing is elementwise, so it preserves the per-shard layout
+    return index.with_packed_codes() if p.pack_codes else index
+
+
+@lru_cache(maxsize=16)
+def _sharded_chunk_coarse_program(mesh, axis: str, n_lists_local: int,
+                                  max_iter: int, penalty: float,
+                                  bal_cap: int, seed: int):
+    """Per-shard coarse fit for the sharded streaming build: each device
+    balanced-fits ITS local centroids on ITS host-sampled trainset stripe
+    and emits a residual sample for the central (tiny) codebook fit —
+    the chunked analog of :func:`_sharded_coarse_program`, taking the
+    trainset directly instead of sampling device-resident rows."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..cluster.kmeans import _balanced_fit_impl
+
+    def local(xt_l):
+        shard = jax.lax.axis_index(axis)
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), shard)
+        c, _, _, _ = _balanced_fit_impl(
+            xt_l, key, n_lists_local, max_iter, penalty, bal_cap)
+        lbl = jnp.argmin(sq_l2(xt_l, c), axis=1)
+        return c, xt_l.astype(c.dtype) - c[lbl]
+
+    return jax.jit(shard_map(
+        local, mesh=mesh, in_specs=P(axis), out_specs=(P(axis), P(axis)),
+        check_vma=False))
+
+
+@lru_cache(maxsize=16)
+def _sharded_chunk_step_program(mesh, axis: str, n_lists_local: int,
+                                cap: int, m: int):
+    """Data-parallel fused chunk step: every device runs
+    :func:`_pq_chunk_step`'s body (assign → residual → encode → scatter)
+    on ITS slice of the chunk against ITS local lists — one jitted
+    shard_map program per chunk, slabs donated, codebooks replicated,
+    zero cross-device data movement."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..cluster.kmeans import _capped_assign_impl
+    from ._packing import _scatter_append_impl
+
+    def local(codes_l, cn_l, ids_l, counts_l, c_l, cb, xc_l, idc_l):
+        valid = idc_l >= 0
+        labels, _ = _capped_assign_impl(xc_l, c_l, cap - counts_l, valid)
+        residuals = xc_l - c_l[jnp.clip(labels, 0, n_lists_local - 1)]
+        ch_codes, ch_norms = _encode(residuals, cb, m)
+        (codes_l, cn_l, ids_l), counts_l = _scatter_append_impl(
+            (codes_l, cn_l, ids_l), counts_l, labels,
+            (ch_codes, ch_norms, idc_l), n_lists=n_lists_local, cap=cap)
+        return codes_l, cn_l, ids_l, counts_l
+
+    return jax.jit(shard_map(
+        local, mesh=mesh, in_specs=(P(axis),) * 5 + (P(), P(axis), P(axis)),
+        out_specs=(P(axis),) * 4, check_vma=False),
+        donate_argnums=(0, 1, 2, 3))
+
+
+@lru_cache(maxsize=16)
+def _sharded_chunk_finalize_program(mesh, axis: str, n_lists_local: int,
+                                    store_recon: bool):
+    """Derived-tier finalize for the sharded streaming build: per-shard
+    recon slab decode and hoisted-ADC tables, elementwise over the local
+    list axis so the shard layout is preserved (same shape as the tail of
+    :func:`_sharded_encode_program`)."""
+    from jax.sharding import PartitionSpec as P
+
+    def local(codes_l, cnorms_l, ids_l, c_l, cb):
+        if store_recon:
+            rec, rnorms = _decode_slab(codes_l, c_l, cb, ids_l)
+        else:  # static-shape placeholders dropped by the caller
+            rec = jnp.zeros((n_lists_local, 1, 1), jnp.bfloat16)
+            rnorms = jnp.zeros((n_lists_local, 1), jnp.float32)
+        clut, anorms = _adc_tables(codes_l, c_l, cb, cnorms_l)
+        return rec, rnorms, clut, anorms
+
+    return jax.jit(shard_map(
+        local, mesh=mesh, in_specs=(P(axis),) * 4 + (P(),),
+        out_specs=(P(axis),) * 4, check_vma=False))
+
+
+def build_chunked_sharded(dataset, mesh,
+                          params: Optional[IvfPqIndexParams] = None, *,
+                          chunk_rows: int = 0, source_ids=None,
+                          axis: str = "shard") -> IvfPqIndex:
+    """Distributed streaming build — the build-side analog of
+    :func:`search_sharded`: the dataset stays on host and each fixed-size
+    chunk splits contiguously over the mesh axis (one sharded
+    ``device_put``, staged a chunk ahead), every device encoding and
+    appending its slice into ITS OWN local lists via the fused donated
+    chunk step.  :func:`build_chunked`'s out-of-core pipeline (fixed
+    shapes, padded tail, single executable) on
+    :func:`build_sharded`'s shard-local sub-index model; only the tiny PQ
+    codebook fit is centralized (on a gathered per-shard residual
+    sample), then replicated.  Per-device peak = local code slabs + its
+    chunk slice."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ._packing import (build_heartbeat, chunked_shard_rows,
+                           chunked_shard_trainsets, prefetch_chunks_padded,
+                           resolve_chunk_rows, sharded_train_sizes)
+
+    p = params or IvfPqIndexParams()
+    n, d = dataset.shape
+    m = p.pq_dim or max(1, d // 4)
+    expects(d % m == 0, f"dim {d} must divide by pq_dim {m}")
+    expects(4 <= p.pq_bits <= 8, "pq_bits must be in [4, 8]")
+    expects(not p.pack_codes or p.pq_bits <= 4,
+            "pack_codes requires pq_bits <= 4")
+    cc = 1 << p.pq_bits
+    n_dev = int(mesh.shape[axis])
+    n_lists_local = max(1, (p.n_lists + n_dev - 1) // n_dev)
+    chunk_rows = resolve_chunk_rows(chunk_rows, n, d, "ivf_pq")
+    chunk_rows = min(-(-chunk_rows // n_dev), -(-n // n_dev)) * n_dev
+    shard_valid = chunked_shard_rows(n, chunk_rows, n_dev)
+    expects(int(shard_valid.min()) >= 1,
+            f"chunk layout leaves a shard with no rows (n={n}, "
+            f"chunk_rows={chunk_rows}, shards={n_dev}): lower chunk_rows "
+            f"or use fewer shards")
+    per = int(shard_valid.max())
+    expects(n_lists_local <= per, "n_lists exceeds rows per shard")
+    cap = max(1, int(np.ceil(p.list_cap_ratio * per / n_lists_local)))
+    kp = KMeansParams()
+    n_train, bal_cap = sharded_train_sizes(
+        per, n_lists_local, p.kmeans_trainset_fraction, kp.balanced_max_ratio)
+    sharding = NamedSharding(mesh, P(axis))
+
+    xt = chunked_shard_trainsets(dataset, n, chunk_rows, n_dev, n_train,
+                                 p.seed)
+    xt_sh = jax.device_put(xt.reshape(n_dev * n_train, d), sharding)
+    coarse = _sharded_chunk_coarse_program(
+        mesh, axis, n_lists_local, p.kmeans_n_iters,
+        float(kp.balanced_penalty), bal_cap, p.seed)
+    centroids, res_sample = coarse(xt_sh)
+    # codebooks: tiny (m·2^bits·ds floats) — one central fit, replicated
+    codebooks = _train_codebooks(
+        res_sample, jax.random.fold_in(jax.random.PRNGKey(p.seed), 7),
+        m, cc, p.pq_kmeans_n_iters)
+    codebooks = jax.device_put(codebooks, NamedSharding(mesh, P()))
+
+    L = n_dev * n_lists_local
+    codes = jax.device_put(jnp.zeros((L, cap, m), jnp.uint8), sharding)
+    cnorms = jax.device_put(jnp.zeros((L, cap), jnp.float32), sharding)
+    ids_slab = jax.device_put(jnp.full((L, cap), -1, jnp.int32), sharding)
+    counts = jax.device_put(jnp.zeros((L,), jnp.int32), sharding)
+    step = _sharded_chunk_step_program(mesh, axis, n_lists_local, cap, m)
+    heartbeat = build_heartbeat("ivf_pq.build_chunked_sharded", n)
+    for lo, hi, xc, idc in prefetch_chunks_padded(
+            dataset, chunk_rows, source_ids, sharding=sharding):
+        codes, cnorms, ids_slab, counts = step(
+            codes, cnorms, ids_slab, counts, centroids, codebooks, xc, idc)
+        heartbeat(hi)
+
+    finalize = _sharded_chunk_finalize_program(
+        mesh, axis, n_lists_local, bool(p.store_recon))
+    rec, rnorms, clut, anorms = finalize(codes, cnorms, ids_slab, centroids,
+                                         codebooks)
+    index = IvfPqIndex(
+        centroids, codebooks, codes, cnorms, ids_slab, counts, p.metric,
+        rec if p.store_recon else None,
+        rnorms if p.store_recon else None,
+        centroid_lut=clut, adc_norms=anorms,
+    )
     return index.with_packed_codes() if p.pack_codes else index
 
 

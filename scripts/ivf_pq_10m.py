@@ -31,8 +31,15 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                       os.path.expanduser("~/.cache/raft_tpu_jax"))
 
 
+_raw = None  # duplicate of stdout, kept NEXT TO the artifact (not tmpfs)
+
+
 def log(**kw):
-    print(json.dumps(kw), flush=True)
+    line = json.dumps(kw)
+    print(line, flush=True)
+    if _raw is not None:
+        _raw.write(line + "\n")
+        _raw.flush()
 
 
 def main() -> None:
@@ -60,7 +67,15 @@ def main() -> None:
     out_path = os.path.join(_ROOT, "bench",
                             f"IVF_PQ_{scale}_{backend.upper()}.json")
 
-    log(stage="start", rows=args.rows, nq=nq, backend=backend)
+    # raw run log next to the artifact, written as the run goes: the
+    # original 10M CPU run's only log lived on tmpfs and died with the
+    # container (see IVF_PQ_10M_CPU.provenance.md) — a multi-hour
+    # measurement must never again depend on stdout capture for survival
+    global _raw
+    _raw = open(out_path.replace(".json", ".run.log"), "w")
+
+    log(stage="start", rows=args.rows, nq=nq, backend=backend,
+        argv=sys.argv[1:])
     t0 = time.time()
     res = bench._bench_ivf_pq(rows=args.rows, nq=nq,
                               on_point=lambda pt: log(stage="sweep", **pt))

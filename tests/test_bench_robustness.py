@@ -44,16 +44,43 @@ def _final_line(stdout: str) -> dict:
 
 
 def test_wedged_probe_emits_final_line_fast():
+    """Machine-level hang ("hard" wedge: even a CPU-pinned child sleeps) —
+    nothing to fall back to, so the contract is the errored final line,
+    fast.  RAFT_BENCH_PLATFORM is pinned here, so the CPU fallback path
+    correctly does not engage either."""
     t0 = time.time()
     p = subprocess.run([sys.executable, BENCH], capture_output=True, text=True,
                        timeout=120,
-                       env=_env(RAFT_BENCH_FAKE_WEDGE=1,
+                       env=_env(RAFT_BENCH_FAKE_WEDGE="hard",
                                 RAFT_BENCH_PROBE_TIMEOUT_S=3))
     assert p.returncode == 0
     d = _final_line(p.stdout)
     assert "backend unavailable" in d["error"]
     assert d["value"] == 0.0
     assert time.time() - t0 < 60
+
+
+def test_wedged_probe_falls_back_to_cpu():
+    """The r5 failure shape (BENCH_r05.json: value 0.0, "probe timed out
+    after 180s"): the bare-init probe wedges but the host is healthy.  The
+    driver must pin the CPU backend, re-probe, and record a CPU-tagged
+    smoke measurement — NOT an empty errored run."""
+    env = _env(RAFT_BENCH_FAKE_WEDGE=1,        # wedge only while unpinned
+               RAFT_BENCH_PROBE_TIMEOUT_S=3,
+               RAFT_BENCH_BF_ROWS=2000,        # CPU-feasible scale
+               RAFT_BENCH_SKIP="pairwise,ivf_pq,cagra,ivf_flat")
+    del env["RAFT_BENCH_PLATFORM"]             # fallback is the pinner
+    p = subprocess.run([sys.executable, BENCH], capture_output=True, text=True,
+                       timeout=600, env=env)
+    assert p.returncode == 0, p.stderr
+    d = _final_line(p.stdout)
+    assert "error" not in d, d
+    assert d["backend"] == "cpu"
+    assert d["value"] > 0                      # a real measurement landed
+    assert "smoke" in d["metric"]              # and is labeled CPU-smoke
+    fb = d["profile"]["probe_fallback"]
+    assert fb["backend"] == "cpu"
+    assert "timed out" in fb["primary_error"]
 
 
 def test_hung_config_watchdog_keeps_ladder_alive():

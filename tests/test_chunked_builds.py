@@ -243,6 +243,166 @@ class TestDataParallelSearch:
         np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
 
 
+class TestPipelinedChunkEngine:
+    """PR 4 tentpole: fused, slab-donating, fixed-shape chunk engine.
+
+    The pipelined stream (masked assignment + padded tail + single fused
+    dispatch per chunk) is a pure *scheduling* change, so it must be
+    BIT-identical to the seed per-op loop — slabs, norms, ids, counts —
+    at every chunk-boundary shape, and the whole stream must run through
+    one cached executable (TraceGuard: zero recompiles and zero implicit
+    transfers after the first chunk).
+    """
+
+    # n % chunk_rows ∈ {0, 1, chunk_rows−1}: exact fit, one-row tail,
+    # near-full tail — the three padding regimes of the fixed-shape engine
+    BOUNDARY = [1024, 1025, 1279]
+
+    @pytest.fixture(scope="class")
+    def xbig(self):
+        rng = np.random.default_rng(11)
+        return rng.standard_normal((1279, 32)).astype(np.float32)
+
+    @pytest.mark.parametrize("n", BOUNDARY)
+    def test_ivf_flat_bitwise_vs_perop(self, xbig, n):
+        p = ivf_flat.IvfFlatIndexParams(n_lists=16, seed=1)
+        a = ivf_flat.build_chunked(xbig[:n], p, chunk_rows=256)
+        b = ivf_flat._build_chunked_perop(xbig[:n], p, chunk_rows=256)
+        for f in ("centroids", "data", "ids", "counts", "norms"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(a, f)), np.asarray(getattr(b, f)),
+                err_msg=f)
+        assert int(np.asarray(a.counts).sum()) == n
+
+    @pytest.mark.parametrize("n", BOUNDARY)
+    def test_ivf_pq_bitwise_vs_perop(self, xbig, n):
+        p = ivf_pq.IvfPqIndexParams(n_lists=16, pq_dim=8, seed=1)
+        a = ivf_pq.build_chunked(xbig[:n], p, chunk_rows=256)
+        b = ivf_pq._build_chunked_perop(xbig[:n], p, chunk_rows=256)
+        for f in ("centroids", "codebooks", "codes", "code_norms", "ids",
+                  "counts"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(a, f)), np.asarray(getattr(b, f)),
+                err_msg=f)
+        assert int(np.asarray(a.counts).sum()) == n
+
+    def test_ivf_flat_chunked_matches_build_bitwise(self, xbig):
+        """Full trainset + ample capacity: training sees the same rows in
+        the same order and capacity never binds, so each row lands in its
+        nearest list in stream order == row order — the streamed build
+        must equal the one-shot :func:`ivf_flat.build` bit-for-bit."""
+        p = ivf_flat.IvfFlatIndexParams(n_lists=8, seed=2,
+                                        kmeans_trainset_fraction=1.0,
+                                        list_cap_ratio=8.0)
+        ref = ivf_flat.build(xbig, p)
+        idx = ivf_flat.build_chunked(xbig, p, chunk_rows=256)
+        # regime check: the ample-capacity assumption actually held
+        assert int(np.asarray(idx.counts).max()) < ref.list_cap
+        for f in ("centroids", "data", "ids", "counts", "norms"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(idx, f)), np.asarray(getattr(ref, f)),
+                err_msg=f)
+
+    def test_ivf_pq_chunked_matches_build_bitwise(self, xbig):
+        p = ivf_pq.IvfPqIndexParams(n_lists=8, pq_dim=8, seed=2,
+                                    kmeans_trainset_fraction=1.0,
+                                    list_cap_ratio=8.0)
+        ref = ivf_pq.build(xbig, p)
+        idx = ivf_pq.build_chunked(xbig, p, chunk_rows=256)
+        assert int(np.asarray(idx.counts).max()) < ref.list_cap
+        for f in ("centroids", "codebooks", "codes", "code_norms", "ids",
+                  "counts"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(idx, f)), np.asarray(getattr(ref, f)),
+                err_msg=f)
+
+    def test_ivf_flat_stream_steady_state(self, xbig):
+        """One executable serves every chunk: warm the fused step on a
+        SHORT stream, then push a LONGER stream (more chunks, padded tail)
+        through a :class:`TraceGuard` — zero retraces, zero recompiles,
+        zero implicit transfers in the chunk loop."""
+        from raft_tpu.core import TraceGuard
+        from raft_tpu.neighbors.ivf_flat import (_coarse_train_chunked,
+                                                 _stream_pipelined)
+        p = ivf_flat.IvfFlatIndexParams(n_lists=16, seed=3)
+        n = xbig.shape[0]
+        cap = int(np.ceil(p.list_cap_ratio * n / p.n_lists))
+        centroids = _coarse_train_chunked(xbig, p, n)
+        # warmup: 2 chunks (first chunk compiles the one fused program)
+        _stream_pipelined(xbig[:512], centroids, p, 512, cap, 256, None,
+                          jnp.float32)
+        with TraceGuard() as tg:  # transfer_guard("disallow") inside
+            _, _, counts = _stream_pipelined(
+                xbig, centroids, p, n, cap, 256, None, jnp.float32)
+        assert int(np.asarray(counts).sum()) == n
+        tg.assert_steady_state(max_traces=0, max_compiles=0)
+
+    def test_ivf_pq_stream_steady_state(self, xbig):
+        from raft_tpu.core import TraceGuard
+        from raft_tpu.neighbors.ivf_pq import (_pq_train_chunked,
+                                               _pq_stream_pipelined)
+        p = ivf_pq.IvfPqIndexParams(n_lists=16, pq_dim=8, seed=3)
+        n = xbig.shape[0]
+        m, c = 8, 256
+        cap = int(np.ceil(p.list_cap_ratio * n / p.n_lists))
+        centroids, codebooks = _pq_train_chunked(xbig, p, n, m, c)
+        _pq_stream_pipelined(xbig[:512], centroids, codebooks, p, 512, m,
+                             cap, 256, None)
+        with TraceGuard() as tg:
+            *_, counts = _pq_stream_pipelined(
+                xbig, centroids, codebooks, p, n, m, cap, 256, None)
+        assert int(np.asarray(counts).sum()) == n
+        tg.assert_steady_state(max_traces=0, max_compiles=0)
+
+    def test_source_ids_roundtrip(self, xbig):
+        """Caller ids survive the padded stream (pads are −1 internally
+        and must never leak into the packed lists)."""
+        n = 1025
+        ids = np.arange(5000, 5000 + n, dtype=np.int32)
+        p = ivf_flat.IvfFlatIndexParams(n_lists=16, seed=1)
+        idx = ivf_flat.build_chunked(xbig[:n], p, chunk_rows=256,
+                                     source_ids=ids)
+        got = np.asarray(idx.ids)
+        np.testing.assert_array_equal(np.sort(got[got >= 0]), ids)
+
+
+class TestChunkedSharded:
+    """PR 4: ``build_chunked_sharded`` — the build-side analog of
+    ``search_sharded``: chunks split contiguously over the mesh axis, each
+    device streaming its slice into its OWN local lists."""
+
+    def test_ivf_flat_chunked_sharded(self, data, mesh8):
+        x, q, gt = data
+        p = ivf_flat.IvfFlatIndexParams(n_lists=64, seed=5)
+        idx = ivf_flat.build_chunked_sharded(x, mesh8, p, chunk_rows=1024)
+        assert idx.size == x.shape[0]
+        ids = np.asarray(idx.ids)
+        got = np.sort(ids[ids >= 0])
+        np.testing.assert_array_equal(got, np.arange(x.shape[0]))
+        # shard s's lists hold only rows from shard s's chunk stripes
+        ll = idx.n_lists // 8
+        pc = 1024 // 8
+        for s in range(8):
+            blk = ids[s * ll:(s + 1) * ll]
+            valid = blk[blk >= 0]
+            assert valid.size and np.all((valid // pc) % 8 == s)
+        _, i2 = ivf_flat.search_sharded(
+            idx, q, 10, ivf_flat.IvfFlatSearchParams(n_probes=16), mesh=mesh8)
+        assert float(neighborhood_recall(np.asarray(i2), gt)) > 0.8
+
+    def test_ivf_pq_chunked_sharded(self, data, mesh8):
+        x, q, gt = data
+        p = ivf_pq.IvfPqIndexParams(n_lists=64, pq_dim=16, seed=5)
+        idx = ivf_pq.build_chunked_sharded(x, mesh8, p, chunk_rows=1024)
+        assert idx.size == x.shape[0]
+        ids = np.asarray(idx.ids)
+        np.testing.assert_array_equal(np.sort(ids[ids >= 0]),
+                                      np.arange(x.shape[0]))
+        _, i2 = ivf_pq.search_sharded(
+            idx, q, 10, ivf_pq.IvfPqSearchParams(n_probes=16), mesh=mesh8)
+        assert float(neighborhood_recall(np.asarray(i2), gt)) > 0.3
+
+
 class TestPrefetchChunks:
     def test_yields_all_rows_in_order(self, rng):
         from raft_tpu.neighbors._packing import prefetch_chunks
@@ -260,3 +420,62 @@ class TestPrefetchChunks:
         ids = np.arange(1000, 1100, dtype=np.int32)
         got = [idc for *_, idc in prefetch_chunks(x, 64, ids)]
         np.testing.assert_array_equal(np.concatenate(got), ids)
+
+    def test_padded_fixed_shapes_and_tail_mask(self, rng):
+        """Every staged chunk has the SAME device shape; tail pads carry
+        id −1 (the chunk step's row mask) and zero data."""
+        from raft_tpu.neighbors._packing import prefetch_chunks_padded
+        x = rng.standard_normal((1000, 4)).astype(np.float32)
+        chunks = list(prefetch_chunks_padded(x, 256))
+        assert [(lo, hi) for lo, hi, *_ in chunks] == [
+            (0, 256), (256, 512), (512, 768), (768, 1000)]
+        for lo, hi, xc, idc in chunks:
+            assert xc.shape == (256, 4) and idc.shape == (256,)
+            np.testing.assert_array_equal(np.asarray(xc)[:hi - lo], x[lo:hi])
+            np.testing.assert_array_equal(np.asarray(idc)[:hi - lo],
+                                          np.arange(lo, hi))
+            assert np.all(np.asarray(idc)[hi - lo:] == -1)
+            assert np.all(np.asarray(xc)[hi - lo:] == 0.0)
+
+    def test_padded_casts_dtype(self, rng):
+        from raft_tpu.neighbors._packing import prefetch_chunks_padded
+        x = rng.standard_normal((100, 4)).astype(np.float64)
+        (_, _, xc, _), = prefetch_chunks_padded(x, 128, dtype=jnp.bfloat16)
+        assert xc.dtype == jnp.bfloat16
+
+    def test_resolve_chunk_rows(self):
+        from raft_tpu.neighbors._packing import (DEFAULT_CHUNK_ROWS,
+                                                 resolve_chunk_rows)
+        # explicit request wins, clamped to the dataset
+        assert resolve_chunk_rows(512, 10_000, 64, "ivf_flat") == 512
+        assert resolve_chunk_rows(512, 100, 64, "ivf_flat") == 100
+        # auto: table entry if measured, else the default, clamped to n
+        auto = resolve_chunk_rows(0, 10 ** 9, 64, "ivf_flat")
+        assert 1 <= auto <= 10 ** 9
+        assert resolve_chunk_rows(0, 100, 64, "ivf_flat") <= 100
+        assert DEFAULT_CHUNK_ROWS > 0
+
+    def test_chunked_shard_rows_partition(self):
+        """Stripe accounting: per-shard valid-row totals partition n for
+        any (n, chunk_rows, n_dev) — incl. short tails that starve the
+        high shards."""
+        from raft_tpu.neighbors._packing import chunked_shard_rows
+        for n, c, s in [(1000, 256, 8), (1024, 256, 4), (999, 512, 8),
+                        (4096, 1024, 8)]:
+            per = chunked_shard_rows(n, c, s)
+            assert per.sum() == n, (n, c, s)
+            assert per.min() >= 0
+
+    def test_chunked_shard_trainsets_rows_come_from_own_stripes(self, rng):
+        from raft_tpu.neighbors._packing import chunked_shard_trainsets
+        n, c, s, t = 4096, 1024, 8, 64
+        x = rng.standard_normal((n, 4)).astype(np.float32)
+        xt = chunked_shard_trainsets(x, n, c, s, t, seed=0)
+        assert xt.shape == (s, t, 4)
+        pc = c // s
+        # recover each sampled row's global index and check its stripe
+        flat = {tuple(r): i for i, r in enumerate(x)}
+        for sh in range(s):
+            for r in xt[sh]:
+                gi = flat[tuple(r)]
+                assert (gi // pc) % s == sh
