@@ -84,12 +84,17 @@ def main() -> None:
 
     import datetime
 
+    # full search scope in the artifact header: a recall@effort point is
+    # meaningless without the engine and iteration budget that produced it
     results = {"rows": rows, "dim": d, "k": k, "build_s": round(build_s, 1),
                "backend": jax.default_backend(),
+               "search_impl": cagra.CagraSearchParams().search_impl,
                "date": datetime.date.today().isoformat(), "points": []}
     for itopk, width in [(32, 4), (64, 4), (64, 8), (128, 8)]:
         sp = cagra.CagraSearchParams(itopk_size=itopk, search_width=width)
-        row = {"itopk": itopk, "width": width}
+        _, _, iters, _ = cagra._resolve_search(sp, k, rows)
+        row = {"itopk_size": itopk, "search_width": width,
+               "iterations": iters}
         for name, ix in (("optimized", idx), ("raw_knn", raw_idx)):
             run = lambda: cagra.search(ix, q, k, sp)
             from ann import _fetch

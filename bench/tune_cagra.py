@@ -1,0 +1,228 @@
+"""Offline CAGRA search tuning — pick ``(itopk_size, search_width)`` per
+``(k, n)`` bucket by measurement, the trained-heuristic pattern of
+``bench/tune_probe_block.py`` with one crucial difference: **this knob
+changes results**, so the tuner is RECALL-GATED — a config only competes
+on QPS after clearing the recall floor (default 0.95 @ k=10 against
+exact ground truth).  Run on the target backend:
+
+    python bench/tune_cagra.py [--quick] [--cpu]
+
+Writes ``raft_tpu/neighbors/_cagra_search_table.json`` keyed
+``cagra:{k.bit_length()}:{n.bit_length()}`` →  ``[itopk, width]`` —
+``resolve_cagra_search``'s 0 (auto) consults it at call time with EXACT
+bucket match only; absent entries fall back to the historical (64, 4).
+
+Also writes the frontier A/B acceptance artifact
+``bench/CAGRA_FRONTIER_<BACKEND>.json``: the frontier engine vs the
+per-parent reference at the frontier-bound grid point (widest frontier).
+The engines are bit-identical (tests/test_cagra_frontier.py), so the A/B
+compares pure wall-clock at equal recall.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+# persistent XLA executable cache (shared with bench.py): repeat runs
+# on the same machine skip recompilation
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.expanduser("~/.cache/raft_tpu_jax"))
+
+import jax
+
+from _platform import pin_backend
+
+# MUST precede any backend use (see tune_select_k.py: the axon plugin's
+# sitecustomize overrides a bare JAX_PLATFORMS env var)
+pin_backend(sys.argv)
+
+import numpy as np
+
+from ann import ground_truth, make_clustered, measure_qps
+from raft_tpu.neighbors import cagra
+from raft_tpu.neighbors._packing import resolve_cagra_search
+from raft_tpu.stats import neighborhood_recall
+
+DIM, NQ, K = 64, 256, 10
+RECALL_FLOOR = 0.95
+ITOPK_GRID = [32, 64, 128]
+WIDTH_GRID = [1, 2, 4, 8]
+N_GRID = [40_000]
+QUICK_N_GRID = [8_000]
+# frontier-bound grid point: at the LARGE beam the per-parent engine's
+# width ranked merges + O(itopk²) membership product dominate the
+# iteration, which is exactly the cost the frontier fold deletes (at
+# itopk=64 the distance einsum dominates and the engines tie)
+AB_POINT = (128, 8)
+
+
+def bucket_key(k: int, n: int) -> str:
+    """Must mirror ``resolve_cagra_search``'s table key scheme exactly."""
+    return f"cagra:{k.bit_length()}:{n.bit_length()}"
+
+
+def kernel_sha() -> str:
+    """Hash of the search-engine sources the measurements depend on —
+    recorded in the sidecar (stale-table detection) and scoping the
+    resume checkpoint."""
+    import hashlib
+
+    root = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+    h = hashlib.sha256()
+    for rel in ("raft_tpu/neighbors/cagra.py",
+                "raft_tpu/neighbors/_packing.py",
+                "raft_tpu/matrix/select_k.py",
+                "raft_tpu/ops/pallas/select_k.py"):
+        with open(os.path.join(root, rel), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def _measure(index, q, gt, itopk: int, width: int, impl: str) -> dict:
+    sp = cagra.CagraSearchParams(itopk_size=itopk, search_width=width,
+                                 search_impl=impl)
+    run = lambda: cagra.search(index, q, K, sp)
+    ids = np.asarray(run()[1])
+    rec = float(neighborhood_recall(ids, gt))
+    qps = measure_qps(run, int(q.shape[0]))
+    _, _, iters, _ = cagra._resolve_search(sp, K, index.size)
+    return {"itopk": itopk, "width": width, "iterations": iters,
+            "recall": round(rec, 4), "qps": round(qps, 1)}
+
+
+def main() -> None:
+    quick = "--quick" in sys.argv
+    n_grid = QUICK_N_GRID if quick else N_GRID
+    sha = kernel_sha()
+    backend = jax.default_backend()
+
+    # resume checkpoint: decided buckets flush immediately and a re-run
+    # under the SAME backend + kernel sources skips them
+    ckpt_path = os.path.join(
+        "/tmp", f"tune_cagra.{backend}.u{os.getuid()}.partial.json")
+    table: dict = {}
+    curves: dict = {}
+    try:
+        with open(ckpt_path) as f:
+            prior = json.load(f)
+        if prior.get("backend") == backend and prior.get("kernel_sha") == sha:
+            table = prior.get("table", {})
+            curves = prior.get("curves", {})
+            print(f"resuming: {len(table)} buckets from checkpoint",
+                  file=sys.stderr)
+    except (OSError, ValueError):
+        pass
+
+    warned = []
+
+    def flush_ckpt():
+        try:
+            with open(ckpt_path + ".tmp", "w") as f:
+                json.dump({"backend": backend, "kernel_sha": sha,
+                           "table": table, "curves": curves}, f)
+            os.replace(ckpt_path + ".tmp", ckpt_path)
+        except OSError as e:
+            if not warned:
+                warned.append(True)
+                print(f"WARN: checkpoint flush failing ({e}); a mid-run "
+                      f"kill will lose progress", file=sys.stderr)
+
+    ab = None
+    for n in n_grid:
+        key = bucket_key(K, n)
+        if key in table and key + ":ab" in curves:
+            ab = curves[key + ":ab"]
+            continue
+        data = make_clustered(n + NQ, DIM, max(64, n // 200), seed=3,
+                              scale=2.0)
+        db, q = data[:n], data[n:]
+        gt = ground_truth(q, db, K)
+        index = cagra.build(db, cagra.CagraIndexParams(
+            intermediate_graph_degree=64, graph_degree=32))
+        points = []
+        for itopk in ITOPK_GRID:
+            for width in WIDTH_GRID:
+                pt = _measure(index, q, gt, itopk, width, "frontier")
+                points.append(pt)
+                print(f"n={n} itopk={itopk:4d} w={width} "
+                      f"→ recall={pt['recall']:.4f} qps={pt['qps']:.1f}")
+        # recall gate first, QPS second; if nothing clears the floor the
+        # most accurate config wins (auto must never silently pick a
+        # fast-but-useless beam)
+        cleared = [p for p in points if p["recall"] >= RECALL_FLOOR]
+        pool = cleared or [max(points, key=lambda p: p["recall"])]
+        best = max(pool, key=lambda p: p["qps"])
+        table[key] = [best["itopk"], best["width"]]
+        curves[key] = {"n": n, "k": K, "recall_floor": RECALL_FLOOR,
+                       "points": points, "chosen": best}
+        print(f"bucket {key} → itopk={best['itopk']} width={best['width']} "
+              f"(recall {best['recall']}, {best['qps']} qps)")
+
+        # frontier A/B at the frontier-bound point, same index + gt
+        it_ab, w_ab = AB_POINT
+        front = _measure(index, q, gt, it_ab, w_ab, "frontier")
+        perp = _measure(index, q, gt, it_ab, w_ab, "per_parent")
+        ab = {"rows": n, "dim": DIM, "nq": NQ, "k": K,
+              "itopk_size": it_ab, "search_width": w_ab,
+              "iterations": front["iterations"],
+              "frontier": {"recall": front["recall"], "qps": front["qps"]},
+              "per_parent": {"recall": perp["recall"], "qps": perp["qps"]},
+              "speedup": round(front["qps"] / perp["qps"], 3)}
+        curves[key + ":ab"] = ab
+        flush_ckpt()
+        print(f"A/B @ itopk={it_ab} w={w_ab}: frontier {front['qps']:.1f} "
+              f"qps vs per_parent {perp['qps']:.1f} qps "
+              f"({ab['speedup']:.2f}x, recall {front['recall']} vs "
+              f"{perp['recall']})")
+
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                       "raft_tpu", "neighbors", "_cagra_search_table.json")
+    if backend != "tpu" and "--force" not in sys.argv:
+        # an off-TPU run must never clobber the table the TPU search
+        # paths consult (same rule as the probe_block tuner)
+        out = out.replace(".json", f".{backend}.json")
+        print(f"non-TPU backend: writing to {os.path.basename(out)} "
+              f"(--force overrides)", file=sys.stderr)
+    with open(out, "w") as f:
+        json.dump(table, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+    import datetime
+
+    with open(out.replace(".json", ".meta.json"), "w") as f:
+        json.dump({"backend": backend,
+                   "date": datetime.date.today().isoformat(),
+                   "kernel_sha": sha,
+                   "recall_floor": RECALL_FLOOR,
+                   "n_entries": len(table),
+                   "curves": curves}, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+    ab_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           f"CAGRA_FRONTIER_{backend.upper()}.json")
+    with open(ab_path, "w") as f:
+        json.dump({"backend": backend, "kernel_sha": sha,
+                   "date": datetime.date.today().isoformat(),
+                   "note": "frontier-blocked vs per-parent engine at the "
+                           "frontier-bound grid point; bit-identical "
+                           "results by construction "
+                           "(tests/test_cagra_frontier.py)",
+                   "ab": ab}, f, indent=1, sort_keys=True)
+        f.write("\n")
+    try:
+        os.remove(ckpt_path)  # spent: the final table supersedes it
+    except OSError:
+        pass
+    print(f"wrote {len(table)} entries → {os.path.normpath(out)}")
+    print(f"A/B artifact → {os.path.normpath(ab_path)}")
+    # the auto path must be able to see what we just measured
+    it, w = resolve_cagra_search(0, 0, K, n_grid[-1])
+    assert it >= K and w >= 1
+
+
+if __name__ == "__main__":
+    main()

@@ -153,7 +153,8 @@ def select_k(
         else:
             # Real kernel failures (lowering, shapes) propagate — never masked
             # as a silent algorithm switch.
-            vals, idx = select_k_pallas(in_val, k_eff, select_min=select_min)
+            vals, idx = select_k_pallas(in_val, k_eff, select_min=select_min,
+                                        sorted=sorted)
     if algo == SelectAlgo.kTopK:
         # lax.top_k selects largest; negate for min-selection.
         if select_min:

@@ -28,7 +28,7 @@ __all__ = ["pack_lists", "chunked_queries", "chunked_filtered_queries",
            "prefetch_chunks_padded", "build_heartbeat",
            "chunked_shard_rows", "chunked_shard_trainsets",
            "blocked_probe_plan", "resolve_probe_block",
-           "resolve_chunk_rows"]
+           "resolve_chunk_rows", "resolve_cagra_search"]
 
 
 def prefetch_chunks(dataset, chunk_rows: int, ids=None):
@@ -373,6 +373,49 @@ def resolve_chunk_rows(requested: int, n: int, dim: int, family: str) -> int:
     if entry is None:
         entry = DEFAULT_CHUNK_ROWS
     return max(1, min(int(entry), max(1, int(n))))
+
+
+@lru_cache(maxsize=1)
+def _cagra_search_table():
+    """Measured (itopk, width) table written by ``bench/tune_cagra.py``
+    (same offline-tuned-dispatch pattern as ``_probe_block_table``).
+    Canonical name first; a ``.{backend}.json`` suffix holds off-TPU
+    measurements without clobbering the TPU table."""
+    import json
+    import os
+
+    base = os.path.join(os.path.dirname(__file__), "_cagra_search_table")
+    for suffix in (".json", f".{jax.default_backend()}.json"):
+        try:
+            with open(base + suffix) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            continue
+    return {}
+
+
+def resolve_cagra_search(itopk_size: int, search_width: int, k: int,
+                         n: int) -> Tuple[int, int]:
+    """Static ``(itopk, width)`` for a CAGRA search config.
+
+    Nonzero values win; ``0`` = auto: the measured table (log2-bucketed by
+    ``(k, n)``, written by ``bench/tune_cagra.py``; EXACT bucket match
+    only — a point tuned at one scale never extrapolates to another),
+    else the historical defaults ``(64, 4)``.  The resolved itopk is
+    clamped to ≥ k and width to ``[1, itopk]`` (the frontier cannot be
+    wider than the beam).  Unlike ``probe_block``, this knob changes
+    RESULTS (recall/effort), not just speed — which is why the tuner
+    behind the table is recall-gated.  Pure host-int arithmetic."""
+    it, w = int(itopk_size), int(search_width)
+    if not (it and w):
+        entry = _cagra_search_table().get(
+            f"cagra:{int(k).bit_length()}:{int(n).bit_length()}")
+        if entry is None:
+            entry = (64, 4)
+        it = it or int(entry[0])
+        w = w or int(entry[1])
+    it = max(it, int(k))
+    return it, max(1, min(w, it))
 
 
 def sentinel_filtered_ids(vals, ids):

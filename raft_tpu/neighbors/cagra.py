@@ -13,13 +13,18 @@ TPU redesign:
   detour-pruning heuristic: reverse edges give the connectivity the pruning
   step is after, rank interleaving approximates its edge ordering.  The
   whole optimization is numpy index arithmetic — no kernels.
-* **Search**: breadth-limited greedy descent with a fixed iteration count —
-  per step: pick the ``search_width`` best unexplored beam entries, gather
-  their adjacency rows ([nq, width·deg] candidates), compute exact distances
-  with one batched MXU dot, dedup by id (sort-by-id + adjacent-equality mask
-  — the XLA replacement for CAGRA's per-thread hash table), and merge into
-  the beam with ``select_k``.  Everything static-shape; one compile per
-  (nq, k, itopk, width, iters) config.
+* **Search**: breadth-limited greedy descent, frontier-blocked — per
+  iteration the ``search_width`` best unexplored beam entries are expanded
+  AS ONE BLOCK: a single [nq, width·deg] adjacency slab gather, one
+  batch-dim MXU einsum (bit-invariant across width, the probe-block
+  contract), a sorted-ring visited filter (beam ids kept sorted in the
+  carry; membership is a ``searchsorted``, the XLA replacement for CAGRA's
+  per-thread hash table), and one UNSORTED ``select_k`` fold — the single
+  ranked selection happens at exit.  Converged queries become no-op lanes
+  and the iteration cap is a device scalar, so one static-shape executable
+  serves every iteration count ≤ the compiled scan length.  A per-parent
+  reference engine (``search_impl="per_parent"``) is retained and pinned
+  bit-identical.
 * **Sharded**: database sharded over the mesh axis; each shard runs the same
   search program on its sub-graph and one ``all_gather`` + ``select_k``
   merges — identical pattern to IVF-Flat sharded (SURVEY.md §5.7).
@@ -52,6 +57,7 @@ __all__ = [
     "extend",
     "optimize_graph",
     "refine_knn_graph",
+    "resolved_search_params",
     "search",
     "searcher",
     "search_sharded",
@@ -86,10 +92,15 @@ class CagraIndexParams:
 
 @dataclasses.dataclass(frozen=True)
 class CagraSearchParams:
-    itopk_size: int = 64      # beam width (internal top-k)
-    search_width: int = 4     # parents expanded per iteration
+    itopk_size: int = 64      # beam width (internal top-k); 0 = auto (tuned table)
+    search_width: int = 4     # parents expanded per iteration; 0 = auto
     max_iterations: int = 0   # 0 → auto from itopk/width
     n_seeds: int = 32         # random entry points
+    # engine selector: "frontier" expands the whole frontier as one
+    # [nq, width·deg] slab per iteration (production); "per_parent" is the
+    # retained reference engine — same algorithm one parent at a time,
+    # pinned bit-identical in tests/test_cagra_frontier.py
+    search_impl: str = "frontier"
 
 
 @jax.tree_util.register_dataclass
@@ -259,9 +270,10 @@ def _nn_descent_round(x, graph, key, s: int, block: int):
     pad = (-n) % block
     allc_p = jnp.pad(allc, ((0, pad), (0, 0)), constant_values=-1)
     x_p = jnp.pad(x, ((0, pad), (0, 0)))
+    g_p = jnp.pad(graph, ((0, pad), (0, 0)), constant_values=-1)
 
     def score_block(args):
-        xb, cb = args
+        xb, cb, gb = args
         vecs = x[jnp.maximum(cb, 0)]                         # [b, kk+s, d]
         from ._packing import exact_gathered_dots
 
@@ -272,11 +284,15 @@ def _nn_descent_round(x, graph, key, s: int, block: int):
         # dedup by id + drop invalid, then best-kk ascending
         dist, ids = _dedup_by_id(jnp.where(cb < 0, jnp.inf, dist), cb)
         neg, pos = jax.lax.top_k(-dist, kk)
-        return jnp.take_along_axis(ids, pos, axis=1)
+        sel = jnp.take_along_axis(ids, pos, axis=1)
+        # degenerate rows (fewer than kk unique candidates) keep their
+        # current edge at that rank instead of an invalidated slot
+        return jnp.where(sel >= 0, sel, gb)
 
     out = jax.lax.map(score_block,
                       (x_p.reshape(-1, block, x.shape[1]),
-                       allc_p.reshape(-1, block, kk + s)))
+                       allc_p.reshape(-1, block, kk + s),
+                       g_p.reshape(-1, block, kk)))
     return out.reshape(-1, kk)[:n]
 
 
@@ -452,7 +468,14 @@ def _batch_dists(dataset, q, qn, ids, metric: str):
 
 def _dedup_by_id(vals, ids):
     """Invalidate duplicate ids (keep best): sort by (id, val) via two stable
-    argsorts, mask adjacent equals — the hash-table replacement."""
+    argsorts, mask adjacent equals — the hash-table replacement.
+
+    Duplicate slots are invalidated COMPLETELY: value → +inf AND id → −1.
+    Keeping the loser's real id (the pre-fix behavior) let a downstream
+    ``select_k(..., in_idx=...)`` fold resurrect the duplicate at its
+    WORST distance whenever the selection had slack — and every +inf slot
+    carrying id −1 is also what makes inf-tie selection indistinguishable
+    between the frontier and per-parent search engines."""
     order = jnp.argsort(vals, axis=1, stable=True)
     v1 = jnp.take_along_axis(vals, order, axis=1)
     i1 = jnp.take_along_axis(ids, order, axis=1)
@@ -463,30 +486,43 @@ def _dedup_by_id(vals, ids):
         [jnp.zeros((ids.shape[0], 1), bool), i2[:, 1:] == i2[:, :-1]], axis=1
     )
     v2 = jnp.where(dup | (i2 < 0), jnp.inf, v2)
+    i2 = jnp.where(dup, -1, i2)
     return v2, i2
 
 
-@partial(jax.jit, static_argnames=("k", "itopk", "width", "iters", "n_seeds",
-                                   "metric"))
-def _search_impl(dataset, graph, routers, router_nodes, q, key, k: int,
-                 itopk: int, width: int, iters: int, n_seeds: int,
-                 metric: str, keep=None):
-    nq, d = q.shape
-    n = dataset.shape[0]
-    deg = graph.shape[1]
-    qf = q.astype(jnp.float32)
-    qn = jnp.sum(qf * qf, axis=1)
-    # beam scoring takes the RAW query when the 8-bit single-pass tier
-    # applies (the f32 cast would silently disable it); one shared
-    # eligibility rule keeps this in lockstep with the scorer
-    from ._packing import int8_tier_eligible
+def _expand_dists(dataset, q_score, qn, ids, metric: str):
+    """Exact query→candidate distances for a ``[nq, w, deg]`` frontier
+    slab, with ``w`` pinned into the einsum's *batch* dims.
 
-    q_score = q if int8_tier_eligible(dataset, q, d) else qf
+    The frontier parity contract (mirroring the probe-block engine): each
+    candidate's f32 accumulation over d is one independent ``(q, w, c)``
+    lane, so a candidate's distance bits do not depend on how many parents
+    were expanded alongside it — blocked (w = width) and per-parent
+    (w = 1) expansion produce identical values.  Folding w into the
+    candidate dimension would retile the reduction and break
+    frontier == per-parent bit parity."""
+    nq, w, _ = ids.shape
+    vecs = dataset[jnp.maximum(ids, 0)]            # [nq, w, deg, d]
+    from ._packing import exact_gathered_dots
 
-    # per-query seeds: nearest router entry nodes (covers every dataset
-    # region incl. disconnected components) + shared random extras
+    qw = jnp.broadcast_to(q_score[:, None, :], (nq, w, q_score.shape[1]))
+    dots = exact_gathered_dots("qwcd,qwd->qwc", vecs, qw)
+    if metric == "inner_product":
+        return -dots
+    vn = jnp.sum(vecs.astype(jnp.float32) ** 2, axis=3)
+    return jnp.maximum(vn - 2.0 * dots + qn[:, None, None], 0.0)
+
+
+def _seed_beam(dataset, routers, router_nodes, q, q_score, qn, key,
+               itopk: int, n_seeds: int, metric: str):
+    """Shared seed phase of both search engines: per-query nearest router
+    entry nodes (covers every dataset region incl. disconnected
+    components) + shared random extras, scored, deduped, ranked into the
+    initial beam.  One implementation — the engines cannot drift here."""
     from ..distance.pairwise import sq_l2
 
+    nq = q.shape[0]
+    n = dataset.shape[0]
     rd = sq_l2(q, routers)                                  # [nq, R]
     n_route = min(n_seeds, routers.shape[0])
     _, rsel = jax.lax.top_k(-rd, n_route)
@@ -499,42 +535,41 @@ def _search_impl(dataset, graph, routers, router_nodes, q, key, k: int,
         )
     seed_vals = _batch_dists(dataset, q_score, qn, seed_ids, metric)
     seed_vals, seed_ids = _dedup_by_id(seed_vals, seed_ids)
-    beam_val, beam_idx = select_k(seed_vals, itopk, in_idx=seed_ids,
-                                  select_min=True)
-    explored = jnp.zeros((nq, itopk), bool) | (beam_idx < 0)
+    return select_k(seed_vals, itopk, in_idx=seed_ids, select_min=True)
 
-    def step(carry, _):
-        beam_val, beam_idx, explored = carry
-        # pick `width` best unexplored parents
-        pv = jnp.where(explored, jnp.inf, beam_val)
-        _, ppos = jax.lax.top_k(-pv, width)           # positions in beam
-        parents = jnp.take_along_axis(beam_idx, ppos, axis=1)  # [nq, w]
-        live = jnp.isfinite(jnp.take_along_axis(pv, ppos, axis=1))
-        explored = explored.at[jnp.arange(nq)[:, None], ppos].set(True)
-        # expand adjacency
-        nbrs = graph[jnp.maximum(parents, 0)].reshape(nq, width * deg)
-        nbrs = jnp.where(jnp.repeat(live, deg, axis=1), nbrs, -1)
-        nvals = _batch_dists(dataset, q_score, qn, nbrs, metric)
-        nvals = jnp.where(nbrs >= 0, nvals, jnp.inf)
-        # merge + dedup
-        all_vals = jnp.concatenate([beam_val, nvals], axis=1)
-        all_ids = jnp.concatenate([beam_idx, nbrs], axis=1)
-        dv, di = _dedup_by_id(all_vals, all_ids)
-        pos = jnp.tile(jnp.arange(dv.shape[1])[None, :], (nq, 1))
-        mv, mpos = select_k(dv, itopk, in_idx=pos, select_min=True)
-        mi = jnp.take_along_axis(di, mpos, axis=1)
-        # carry explored flags through the same permutation chain:
-        # recompute flags by membership — an id stays explored if it was
-        # explored in the old beam (membership test via dedup trick)
-        # map: for each merged id, explored iff it matches an explored old id
-        # O(itopk * itopk) pairwise — small (64×64) and fuses to one VPU op
-        match = (mi[:, :, None] == jnp.where(explored, beam_idx, -2)[:, None, :])
-        mflags = jnp.any(match, axis=2) | (mi < 0)
-        return (mv, mi, mflags), None
 
-    (beam_val, beam_idx, _), _ = jax.lax.scan(
-        step, (beam_val, beam_idx, explored), None, length=iters
-    )
+def _select_parents(beam_val, beam_idx, explored, width: int):
+    """Top-``width`` unexplored beam entries by ascending value — the
+    per-iteration frontier, shared by both engines so they always expand
+    the same parents in the same order.  Exhausted picks (no unexplored
+    finite entry left) report ``live=False`` and expand nothing."""
+    pv = jnp.where(explored, jnp.inf, beam_val)
+    _, ppos = jax.lax.top_k(-pv, width)               # positions in beam
+    parents = jnp.take_along_axis(beam_idx, ppos, axis=1)   # [nq, w]
+    live = jnp.isfinite(jnp.take_along_axis(pv, ppos, axis=1))
+    return parents, ppos, live
+
+
+def _mask_slab_dups(vals, ids):
+    """Invalidate repeats of an id within one expansion slab, keeping the
+    first occurrence.  Copies of a candidate are bit-identical under the
+    pinned accumulation contract (``_expand_dists``), so which copy
+    survives is unobservable — only the multiplicity matters (the beam
+    must never hold one node twice)."""
+    nq, lanes = ids.shape
+    pos = jnp.tile(jnp.arange(lanes, dtype=jnp.int32)[None, :], (nq, 1))
+    order = jnp.argsort(ids, axis=1, stable=True)
+    i1 = jnp.take_along_axis(ids, order, axis=1)
+    p1 = jnp.take_along_axis(pos, order, axis=1)
+    dup = jnp.concatenate(
+        [jnp.zeros((nq, 1), bool), i1[:, 1:] == i1[:, :-1]], axis=1)
+    mask = jnp.zeros_like(dup).at[jnp.arange(nq)[:, None], p1].set(dup)
+    return jnp.where(mask, jnp.inf, vals), jnp.where(mask, -1, ids)
+
+
+def _finish_search(beam_val, beam_idx, k: int, metric: str, keep):
+    """Shared exit: result-stage filter mask + the ONE ranked selection of
+    the whole search, then metric-space output transforms."""
     if keep is not None:
         # result-stage filter: the descent may pass through filtered
         # nodes, but they can never be returned (see search() docstring)
@@ -549,6 +584,220 @@ def _search_impl(dataset, graph, routers, router_nodes, q, key, k: int,
     elif metric == "inner_product":
         out_val = -out_val
     return out_val, out_idx
+
+
+@partial(jax.jit, static_argnames=("k", "itopk", "width", "iters", "n_seeds",
+                                   "metric"))
+def _search_impl(dataset, graph, routers, router_nodes, q, key, iters_cap,
+                 k: int, itopk: int, width: int, iters: int, n_seeds: int,
+                 metric: str, keep=None):
+    """Frontier-blocked beam search (the production engine).
+
+    Each iteration expands ALL ``width`` frontier parents at once: one
+    ``[nq, width·deg]`` slab gather, one batch-dim distance einsum
+    (``_expand_dists`` — bit-invariant across ``width``), one unsorted
+    ``select_k`` fold into the beam.  The beam carry is kept sorted by id
+    (a "sorted ring"), so the visited test for every candidate is a
+    ``searchsorted`` + one gather against the persistent carry instead of
+    the per-iteration double argsort the per-parent engine pays; explored
+    flags ride the fold as a payload instead of being rebuilt from an
+    O(itopk²) membership product.  The only ranked selection happens once,
+    at exit.
+
+    ``iters`` is the static scan length; ``iters_cap`` is a DEVICE scalar
+    — iterations past the cap, and queries whose frontier is exhausted,
+    are no-op lanes (the carry is passed through unchanged), so one
+    executable serves every ``max_iterations`` up to the compiled length.
+
+    Bit-identical (values AND ids) to :func:`_search_impl_perop` at every
+    ``width`` — pinned in tests/test_cagra_frontier.py."""
+    nq, d = q.shape
+    deg = graph.shape[1]
+    qf = q.astype(jnp.float32)
+    qn = jnp.sum(qf * qf, axis=1)
+    # beam scoring takes the RAW query when the 8-bit single-pass tier
+    # applies (the f32 cast would silently disable it); one shared
+    # eligibility rule keeps this in lockstep with the scorer
+    from ._packing import int8_tier_eligible
+
+    q_score = q if int8_tier_eligible(dataset, q, d) else qf
+    beam_val, beam_idx = _seed_beam(dataset, routers, router_nodes, q,
+                                    q_score, qn, key, itopk, n_seeds, metric)
+    explored = beam_idx < 0
+    # sorted-ring layout: beam lanes ordered by id, so membership tests
+    # against the carry are binary searches, not sorts
+    order = jnp.argsort(beam_idx, axis=1)
+    beam_val = jnp.take_along_axis(beam_val, order, axis=1)
+    beam_idx = jnp.take_along_axis(beam_idx, order, axis=1)
+    explored = jnp.take_along_axis(explored, order, axis=1)
+    rows = jnp.arange(nq)[:, None]
+
+    def step(carry, t):
+        bv0, bi0, ex0 = carry
+        # no-op lanes: a converged query (no unexplored finite entry) or
+        # one past the dynamic cap keeps its carry bit-unchanged
+        active = (jnp.any(~ex0 & jnp.isfinite(bv0), axis=1)
+                  & (t < iters_cap))
+        parents, ppos, live = _select_parents(bv0, bi0, ex0, width)
+        live = live & active[:, None]
+        explored2 = ex0.at[rows, ppos].set(True)
+        # fused frontier expansion: one slab gather + one batched einsum
+        nbrs = graph[jnp.maximum(parents, 0)]         # [nq, w, deg]
+        nbrs = jnp.where(live[:, :, None], nbrs, -1)
+        nvals = _expand_dists(dataset, q_score, qn, nbrs, metric)
+        nids = nbrs.reshape(nq, width * deg)
+        nvals = jnp.where(nids >= 0, nvals.reshape(nq, width * deg), jnp.inf)
+        nvals, nids = _mask_slab_dups(nvals, nids)
+        # sorted-ring visited filter: a candidate already in the beam is
+        # dropped; its value folds into the resident entry by scatter-min
+        # — the keep-min the per-parent dedup applies across the
+        # seed/expansion accumulation boundary
+        spos = jax.vmap(
+            lambda a, v: jnp.searchsorted(a, v, method="sort"))(bi0, nids)
+        spos = jnp.minimum(spos, itopk - 1)
+        hit = jnp.take_along_axis(bi0, spos, axis=1) == nids
+        beam_val = bv0.at[rows, jnp.where(hit, spos, itopk)].min(
+            jnp.where(hit, nvals, jnp.inf), mode="drop")
+        nvals = jnp.where(hit, jnp.inf, nvals)
+        nids = jnp.where(hit, -1, nids)
+        # unsorted fold: exact top-itopk *set*, no ranking pass — ids and
+        # explored flags ride the fold as payloads
+        cat_val = jnp.concatenate([beam_val, nvals], axis=1)
+        cpos = jnp.tile(
+            jnp.arange(cat_val.shape[1], dtype=jnp.int32)[None, :], (nq, 1))
+        mv, mpos = select_k(cat_val, itopk, in_idx=cpos, select_min=True,
+                            sorted=False)
+        mi = jnp.take_along_axis(
+            jnp.concatenate([bi0, nids], axis=1), mpos, axis=1)
+        mf = jnp.take_along_axis(
+            jnp.concatenate([explored2, jnp.zeros_like(hit)], axis=1),
+            mpos, axis=1)
+        mi = jnp.where(jnp.isfinite(mv), mi, -1)  # empty slots are id −1
+        mf = mf | (mi < 0)
+        # rebuild the ring: ONE int argsort over itopk lanes (ties only
+        # among identical (inf, −1, True) empties)
+        order = jnp.argsort(mi, axis=1)
+        a = active[:, None]
+        new = tuple(jnp.take_along_axis(x, order, axis=1)
+                    for x in (mv, mi, mf))
+        return tuple(jnp.where(a, nw, od)
+                     for nw, od in zip(new, (bv0, bi0, ex0))), None
+
+    (beam_val, beam_idx, _), _ = jax.lax.scan(
+        step, (beam_val, beam_idx, explored),
+        jnp.arange(iters, dtype=jnp.int32))
+    return _finish_search(beam_val, beam_idx, k, metric, keep)
+
+
+@partial(jax.jit, static_argnames=("k", "itopk", "width", "iters", "n_seeds",
+                                   "metric"))
+def _search_impl_perop(dataset, graph, routers, router_nodes, q, key,
+                       iters_cap, k: int, itopk: int, width: int, iters: int,
+                       n_seeds: int, metric: str, keep=None):
+    """Per-parent reference engine: the SAME frontier per iteration
+    (``_select_parents`` once, like the frontier engine), expanded one
+    parent at a time through the classic concat → ``_dedup_by_id`` →
+    ranked-``select_k`` chain.  Kept as the parity oracle: width ranked
+    merges + width dedup argsort chains per iteration against the
+    frontier engine's single unsorted fold — the A/B in
+    ``bench/CAGRA_FRONTIER_CPU.json`` measures exactly this gap.
+
+    Explored flags are rebuilt once per iteration by membership against
+    the iteration-start visited ids (parents included) — equivalent to
+    the frontier engine's flags-ride-the-fold because surviving candidates
+    can never collide with a visited id (the dedup keeps one copy and the
+    visited copy's minimum value, exactly like the sorted-ring filter's
+    scatter-min)."""
+    nq, d = q.shape
+    deg = graph.shape[1]
+    qf = q.astype(jnp.float32)
+    qn = jnp.sum(qf * qf, axis=1)
+    from ._packing import int8_tier_eligible
+
+    q_score = q if int8_tier_eligible(dataset, q, d) else qf
+    beam_val, beam_idx = _seed_beam(dataset, routers, router_nodes, q,
+                                    q_score, qn, key, itopk, n_seeds, metric)
+    explored = beam_idx < 0
+    rows = jnp.arange(nq)[:, None]
+
+    def step(carry, t):
+        bv0, bi0, ex0 = carry
+        active = (jnp.any(~ex0 & jnp.isfinite(bv0), axis=1)
+                  & (t < iters_cap))
+        parents, ppos, live = _select_parents(bv0, bi0, ex0, width)
+        live = live & active[:, None]
+        ex_marked = ex0.at[rows, ppos].set(True)
+        # the iteration's visited id set, frozen before any expansion
+        vis_ids = jnp.where(ex_marked, bi0, -2)
+        bv, bi = bv0, bi0
+        for j in range(width):        # static unroll: one parent at a time
+            nbrs = graph[jnp.maximum(parents[:, j:j + 1], 0)]  # [nq, 1, deg]
+            nbrs = jnp.where(live[:, j:j + 1, None], nbrs, -1)
+            nvals = _expand_dists(dataset, q_score, qn, nbrs, metric)
+            nids = nbrs.reshape(nq, deg)
+            nvals = jnp.where(nids >= 0, nvals.reshape(nq, deg), jnp.inf)
+            dv, di = _dedup_by_id(jnp.concatenate([bv, nvals], axis=1),
+                                  jnp.concatenate([bi, nids], axis=1))
+            pos = jnp.tile(
+                jnp.arange(dv.shape[1], dtype=jnp.int32)[None, :], (nq, 1))
+            bv, mpos = select_k(dv, itopk, in_idx=pos, select_min=True)
+            bi = jnp.take_along_axis(di, mpos, axis=1)
+        # O(itopk²) membership product — the cost the frontier engine's
+        # flag payload deletes
+        mf = jnp.any(bi[:, :, None] == vis_ids[:, None, :], axis=2) | (bi < 0)
+        a = active[:, None]
+        return (jnp.where(a, bv, bv0), jnp.where(a, bi, bi0),
+                jnp.where(a, mf, ex0)), None
+
+    (beam_val, beam_idx, _), _ = jax.lax.scan(
+        step, (beam_val, beam_idx, explored),
+        jnp.arange(iters, dtype=jnp.int32))
+    return _finish_search(beam_val, beam_idx, k, metric, keep)
+
+
+_SEARCH_ENGINES = {"frontier": _search_impl, "per_parent": _search_impl_perop}
+
+
+def _engine(name: str):
+    expects(name in _SEARCH_ENGINES,
+            f"unknown search_impl {name!r}; expected one of "
+            f"{sorted(_SEARCH_ENGINES)}")
+    return _SEARCH_ENGINES[name]
+
+
+@lru_cache(maxsize=64)
+def _iters_cap(cap: int):
+    """Iteration cap as a memoized device scalar — an OPERAND, not a
+    static: every ``max_iterations`` up to the compiled scan length shares
+    one executable, and the memo keeps repeat searches free of implicit
+    host→device transfers (the ``_search_key`` pattern)."""
+    return jnp.asarray(int(cap), jnp.int32)
+
+
+def _resolve_search(p: "CagraSearchParams", k: int, n: int):
+    """Static search config from params: ``(itopk, width, iters, cap)``
+    with ``iters`` the compiled scan length and ``cap`` the dynamic
+    iteration bound (``iters`` ≥ the auto count so every
+    ``max_iterations`` ≤ auto reuses one executable)."""
+    from ._packing import resolve_cagra_search
+
+    itopk, width = resolve_cagra_search(p.itopk_size, p.search_width,
+                                        int(k), int(n))
+    auto = max(1, (itopk + width - 1) // width)
+    req = int(p.max_iterations)
+    return itopk, width, max(auto, req), (req or auto)
+
+
+def resolved_search_params(index, k: int,
+                           params: Optional[CagraSearchParams] = None
+                           ) -> CagraSearchParams:
+    """Concrete search params for ``index``: 0-valued (auto) ``itopk_size``
+    / ``search_width`` replaced by the tuned-table resolution ``search``
+    itself would use.  The serve layer calls this BEFORE effort scaling,
+    so degradation ladders scale the real beam width, not the sentinel."""
+    p = params or CagraSearchParams()
+    itopk, width, _, _ = _resolve_search(p, k, index.size)
+    return dataclasses.replace(p, itopk_size=itopk, search_width=width)
 
 
 @lru_cache(maxsize=16)
@@ -625,14 +874,16 @@ class ShardedCagraIndex:
 def _sharded_search_program(mesh: Mesh, axis: str, data_axis: Optional[str],
                             k: int, itopk: int, width: int, iters: int,
                             n_seeds: int, metric: str, per: int,
-                            n_rows: int, keep_ndim: int = 0):
+                            n_rows: int, keep_ndim: int = 0,
+                            impl: str = "frontier"):
     """Compile-once sharded search (jit keyed on the static config — a
     per-call closure would re-trace every ``search_sharded`` call, which
     dominates pipelined QPS measurements)."""
+    engine = _engine(impl)
 
-    def local(ds, g, rc, rn, q_l, key, keep_l):
-        bv, bi = _search_impl(ds[0], g[0], rc[0], rn[0], q_l, key, k,
-                              itopk, width, iters, n_seeds, metric)
+    def local(ds, g, rc, rn, q_l, key, cap, keep_l):
+        bv, bi = engine(ds[0], g[0], rc[0], rn[0], q_l, key, cap, k,
+                        itopk, width, iters, n_seeds, metric)
         shard = jax.lax.axis_index(axis)
         bi = jnp.where(bi >= 0, bi + shard * per, bi)
         if metric == "inner_product":
@@ -658,7 +909,7 @@ def _sharded_search_program(mesh: Mesh, axis: str, data_axis: Optional[str],
     kspec = (P(data_axis) if (keep_ndim == 2 and data_axis) else P())
     return jax.jit(shard_map(
         local, mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis), P(axis), qspec, P(), kspec),
+        in_specs=(P(axis), P(axis), P(axis), P(axis), qspec, P(), P(), kspec),
         out_specs=(qspec, qspec),
         check_vma=False,
     ))
@@ -692,17 +943,16 @@ def search_sharded(index: ShardedCagraIndex, queries, k: int,
         expects(data_axis in mesh.axis_names, f"axis {data_axis!r} not in mesh")
         expects(q.shape[0] % int(mesh.shape[data_axis]) == 0,
                 "queries not divisible by data axis")
-    itopk = max(p.itopk_size, k)
-    iters = p.max_iterations or max(1, (itopk + p.search_width - 1)
-                                    // p.search_width)
     per = int(index.datasets.shape[1])
+    itopk, width, iters, cap = _resolve_search(p, k, int(index.n_rows))
     keep = as_keep_mask(filter, n=int(index.n_rows), nq=q.shape[0])
     prog = _sharded_search_program(
-        mesh, axis, data_axis, int(k), int(itopk), int(p.search_width),
-        int(iters), int(min(p.n_seeds, per)), index.metric, per,
-        int(index.n_rows), 0 if keep is None else keep.ndim)
+        mesh, axis, data_axis, int(k), itopk, width, iters,
+        int(min(p.n_seeds, per)), index.metric, per,
+        int(index.n_rows), 0 if keep is None else keep.ndim, p.search_impl)
     dv, di = prog(index.datasets, index.graphs, index.router_centroids,
-                  index.router_nodes, q, _search_key(int(seed)), keep)
+                  index.router_nodes, q, _search_key(int(seed)),
+                  _iters_cap(cap), keep)
     if keep is not None:
         di = sentinel_filtered_ids(dv, di)
     return dv, di
@@ -730,15 +980,12 @@ def search(index: CagraIndex, queries, k: int,
     q = wrap_array(queries, ndim=2, name="queries")
     expects(q.shape[1] == index.dim, "query dim mismatch")
     keep = as_keep_mask(filter, n=index.size, nq=q.shape[0])
-    itopk = max(p.itopk_size, k)
-    iters = p.max_iterations or max(1, (itopk + p.search_width - 1)
-                                    // p.search_width)
+    itopk, width, iters, cap = _resolve_search(p, k, index.size)
     key = _search_key(int(seed))
-    dv, di = _search_impl(index.dataset, index.graph, index.router_centroids,
-                          index.router_nodes, q, key, int(k),
-                          int(itopk), int(p.search_width), int(iters),
-                          int(min(p.n_seeds, index.size)), index.metric,
-                          keep)
+    dv, di = _engine(p.search_impl)(
+        index.dataset, index.graph, index.router_centroids,
+        index.router_nodes, q, key, _iters_cap(cap), int(k), itopk, width,
+        iters, int(min(p.n_seeds, index.size)), index.metric, keep)
     if keep is not None:
         di = sentinel_filtered_ids(dv, di)
     return dv, di
@@ -751,20 +998,21 @@ def searcher(index: CagraIndex, k: int,
     :func:`search` at the same ``seed``.  The PRNG key rides as an operand
     (the beam's random extra seeds are shared across queries, so padded
     serving batches stay row-identical to a direct call); dataset/graph
-    ride as operands so bucket executables share them."""
+    and the dynamic iteration cap ride as operands so bucket executables
+    share them (a ``max_iterations`` change within the compiled scan
+    length never recompiles)."""
     p = params or CagraSearchParams()
     expects(k >= 1, "k must be >= 1")
-    itopk = int(max(p.itopk_size, k))
-    width = int(p.search_width)
-    iters = int(p.max_iterations or max(1, (itopk + width - 1) // width))
+    itopk, width, iters, cap = _resolve_search(p, k, index.size)
     n_seeds = int(min(p.n_seeds, index.size))
     metric = index.metric
+    engine = _engine(p.search_impl)
     key = jax.random.PRNGKey(seed)
 
-    def fn(q, dataset, graph, routers, router_nodes, kk):
-        return _search_impl(dataset, graph, routers, router_nodes, q, kk,
-                            int(k), itopk, width, iters, n_seeds, metric,
-                            None)
+    def fn(q, dataset, graph, routers, router_nodes, kk, cap_dev):
+        return engine(dataset, graph, routers, router_nodes, q, kk,
+                      cap_dev, int(k), itopk, width, iters, n_seeds, metric,
+                      None)
 
     return fn, (index.dataset, index.graph, index.router_centroids,
-                index.router_nodes, key)
+                index.router_nodes, key, _iters_cap(cap))

@@ -117,6 +117,7 @@ def select_k_pallas(
     k: int,
     *,
     select_min: bool = True,
+    sorted: bool = True,
     bm: int = 256,
     bn: int = 2048,
 ) -> Tuple[jax.Array, jax.Array]:
@@ -125,7 +126,16 @@ def select_k_pallas(
     Designed for small k (≤ ~64) over long rows; cost grows linearly with
     k (k min-extract passes), so large k should use ``lax.top_k`` instead
     (the ``SelectAlgo.kAuto`` heuristic handles this).
+
+    ``sorted=False`` accepts the relaxed unsorted-fold contract that
+    ``matrix.select_k`` plumbs through for intermediate merges (the
+    probe-block and CAGRA frontier folds): this kernel's min-extraction
+    passes emit ascending order anyway — a valid refinement, at no extra
+    cost, since the ranking falls out of the extraction rather than a
+    separate pass — so the flag only keeps the fold call signature uniform
+    across dispatch targets.
     """
+    del sorted  # ordered output is a refinement of the unsorted contract
     batch, length = in_val.shape
     bn = min(bn, max(_LANES, length))
     bm = min(bm, max(8, batch))

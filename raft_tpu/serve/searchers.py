@@ -133,7 +133,9 @@ def make_searcher(index, k: int, params=None, *, effort_scale: float = 1.0,
         return ivf_pq.searcher(index, k, p)
     from ..neighbors import cagra
 
-    p = params or cagra.CagraSearchParams()
+    # resolve 0 = auto itopk/width from the tuned table FIRST — scaling
+    # the raw params would multiply the auto sentinel, not the beam
+    p = cagra.resolved_search_params(index, k, params)
     if effort_scale < 1.0:
         p = dataclasses.replace(
             p, itopk_size=_scaled(max(p.itopk_size, k), effort_scale, k))

@@ -1,0 +1,96 @@
+#!/bin/bash
+# Round-8 TPU job queue.  The r7 ladder plus the round-8 addition:
+#   * cagra_tuner — bench/tune_cagra.py measures the recall-gated
+#     (itopk_size, search_width) table (raft_tpu/neighbors/
+#     _cagra_search_table.json) that resolve_cagra_search's 0 = auto
+#     consults, and writes the frontier-vs-per-parent A/B artifact
+#     bench/CAGRA_FRONTIER_<BACKEND>.json.  Staged before cagra_quality
+#     so the quality sweep's auto configs see the tuned table, and after
+#     the generic benches (the tuner builds its own 40k index — cheap
+#     next to bench.py but still chip time).
+# Stage order: jaxlint -> Mosaic check -> build-throughput bench ->
+# probe/chunk tuners -> bench.py -> select_k tuner -> prims ->
+# cagra tuner -> cagra quality -> int8 -> profile.
+# Markers stay in /tmp/tpu_jobs_r3 so steps completed by earlier rounds'
+# queues are not repeated and tpu_ab_r4.sh's wait-chain keeps working.
+set -u
+cd /root/repo || exit 1
+LOG=/tmp/tpu_jobs_r3
+mkdir -p "$LOG"
+. "$(dirname "$0")/tpu_queue_lib.sh"
+acquire_queue_lock tpu_jobs_r8
+
+export RAFT_BENCH_CKPT_DIR="$LOG/bench_ckpt"
+
+# un-latch a bench.done that lacks a headline measurement (r3/r4 queues
+# gated on any measured line; a wedged-headline run must be retried)
+if [ -f "$LOG/bench.done" ] && \
+    ! bench_measured "$LOG/bench.log" brute_force 2>/dev/null; then
+  echo "$(date) removing stale bench.done (no headline measurement)" \
+    >> "$LOG/driver.log"
+  rm -f "$LOG/bench.done"
+fi
+
+# the r8 frontier engine obsoletes any pre-r8 cagra_quality marker: the
+# committed artifact must carry the new engine + scope fields
+if [ -f "$LOG/cagra_quality.done" ] && \
+    ! grep -q search_impl "$LOG/cagra_quality.log" 2>/dev/null && \
+    ! grep -q search_impl bench/CAGRA_QUALITY.json 2>/dev/null; then
+  echo "$(date) removing pre-r8 cagra_quality.done (no engine scope)" \
+    >> "$LOG/driver.log"
+  rm -f "$LOG/cagra_quality.done"
+fi
+
+echo "$(date) [r8 queue] waiting for TPU..." >> "$LOG/driver.log"
+wait_probe
+echo "$(date) TPU is back" >> "$LOG/driver.log"
+
+run_step() {  # name, timeout_s, command...   (two attempts, gated .done)
+  local name=$1 tmo=$2; shift 2
+  [ -f "$LOG/$name.done" ] && return 0
+  local attempt
+  for attempt in 1 2; do
+    echo "$(date) start $name (attempt $attempt)" >> "$LOG/driver.log"
+    timeout "$tmo" "$@" > "$LOG/$name.$attempt.log" 2>&1 9<&-
+    rc=$?
+    cp -f "$LOG/$name.$attempt.log" "$LOG/$name.log"  # latest = canonical
+    if [ "$rc" -eq 0 ]; then
+      if [ "$name" != bench ] || bench_measured "$LOG/$name.log" brute_force; then
+        touch "$LOG/$name.done"
+        echo "$(date) done $name" >> "$LOG/driver.log"
+        return 0
+      fi
+      echo "$(date) $name exited 0 with no headline measurement (wedged backend)" \
+        >> "$LOG/driver.log"
+    else
+      echo "$(date) FAILED $name (rc=$rc)" >> "$LOG/driver.log"
+    fi
+    # a killed/wedged client can poison the tunnel for the next step too:
+    # re-probe before the retry (or before handing on to the next step)
+    wait_probe
+  done
+}
+
+# jaxlint first: pure-host AST pass, ~seconds, zero chip time — a hazard
+# (hidden sync, retrace loop, f64 leak) must never cost TPU minutes to find
+run_step jaxlint        300 python scripts/mini_lint.py --jax raft_tpu --stats-json bench/JAXLINT.json
+run_step mosaic         900 env RAFT_MOSAIC_REQUIRE_TPU=1 python scripts/mosaic_check.py
+run_step build_tp      2400 python bench/build_throughput.py
+# tuners before the big benches: all three have /tmp resume checkpoints
+# (kernel-sha scoped), so a wedge mid-grid resumes on attempt 2
+run_step probe_tuner   3000 python bench/tune_probe_block.py
+run_step chunk_tuner   3000 python bench/tune_chunk_rows.py
+run_step bench         4500 python bench.py
+# the checkpoints exist to survive a wedge WITHIN a bench run; once the
+# headline-gated .done latches they are spent — leaving them would turn a
+# deliberately forced re-measurement (rm bench.done) into a silent replay
+[ -f "$LOG/bench.done" ] && rm -rf "$RAFT_BENCH_CKPT_DIR"
+run_step tuner         3000 python bench/tune_select_k.py
+run_step prims         3000 python bench/prims.py
+# cagra tuner immediately before the quality sweep: the sweep's auto
+# (itopk=0/width=0) points must consult the table this run just measured
+run_step cagra_tuner   3000 python bench/tune_cagra.py
+run_step cagra_quality 3000 python bench/cagra_quality.py
+run_step int8          1500 python scripts/tpu_validate_int8.py
+run_step profile       3000 python bench/profile_knn.py
+echo "$(date) all steps attempted" >> "$LOG/driver.log"

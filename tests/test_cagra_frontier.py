@@ -1,0 +1,230 @@
+"""Frontier-blocked CAGRA search engine gates.
+
+Three contracts pinned here:
+
+* **Engine parity** — the production frontier engine (one ``[nq, w·deg]``
+  slab gather + one unsorted ``select_k`` fold + sorted-ring visited
+  filter per iteration) is BIT-IDENTICAL, values and ids, to the
+  retained per-parent reference engine at every ``search_width``,
+  including the filtered and sharded paths.  This is the CAGRA analog of
+  the probe-block invariance contract: blocking is a schedule, never a
+  semantic.
+* **Dedup keep-best** — ``_dedup_by_id`` must invalidate a duplicate
+  slot COMPLETELY (value → +inf AND id → −1).  The pre-fix behavior kept
+  the loser's real id, letting a downstream ``select_k(..., in_idx=...)``
+  fold resurrect the duplicate at its WORST distance.
+* **Steady state** — one executable serves every ``max_iterations`` up
+  to the compiled scan length, and the serving ``searcher()`` runs mixed
+  query shapes with zero retraces and zero implicit transfers after
+  warmup.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.core import TraceGuard
+from raft_tpu.core.bitset import Bitmap, Bitset
+from raft_tpu.neighbors import cagra
+from raft_tpu.random.datagen import make_blobs
+
+K = 10
+ITOPK = 16
+
+
+@pytest.fixture(scope="module")
+def data():
+    x, _ = make_blobs(jax.random.PRNGKey(7), n_samples=4000, n_features=32,
+                      n_clusters=20, cluster_std=1.0)
+    return np.asarray(x), np.asarray(x[:100])
+
+
+@pytest.fixture(scope="module")
+def index(data):
+    x, _ = data
+    return cagra.build(x, cagra.CagraIndexParams(
+        intermediate_graph_degree=32, graph_degree=16))
+
+
+def _params(impl, width, **kw):
+    return cagra.CagraSearchParams(itopk_size=kw.pop("itopk", ITOPK),
+                                   search_width=width, n_seeds=16,
+                                   search_impl=impl, **kw)
+
+
+def _both(index, q, width, **kw):
+    dv_f, di_f = cagra.search(index, q, K, _params("frontier", width, **kw))
+    dv_p, di_p = cagra.search(index, q, K, _params("per_parent", width, **kw))
+    return (np.asarray(dv_f), np.asarray(di_f),
+            np.asarray(dv_p), np.asarray(di_p))
+
+
+# ---------------------------------------------------------------------------
+# dedup keep-best regression
+
+
+def test_dedup_by_id_invalidates_loser_completely():
+    vals = jnp.asarray([[5.0, 3.0]])
+    ids = jnp.asarray([[7, 7]], jnp.int32)
+    dv, di = cagra._dedup_by_id(vals, ids)
+    dv, di = np.asarray(dv), np.asarray(di)
+    # best copy survives; the loser slot is fully invalidated
+    assert (dv[0] == 3.0).sum() == 1
+    assert (di[0] == 7).sum() == 1
+    drop = dv[0] != 3.0
+    assert np.isinf(dv[0][drop]).all()
+    assert (di[0][drop] == -1).all()
+
+
+def test_dedup_fold_never_resurrects_duplicate():
+    """dedup → ranked select_k(in_idx) with selection slack must not
+    return a duplicate id at its worst distance (the pre-fix bug)."""
+    from raft_tpu.matrix import select_k
+
+    vals = jnp.asarray([[5.0, 3.0, 4.0, 6.0]])
+    ids = jnp.asarray([[7, 7, 9, 11]], jnp.int32)
+    dv, di = cagra._dedup_by_id(vals, ids)
+    out_v, out_i = select_k(dv, 3, in_idx=di, select_min=True)
+    out_v, out_i = np.asarray(out_v), np.asarray(out_i)
+    np.testing.assert_array_equal(out_i[0], [7, 9, 11])
+    np.testing.assert_array_equal(out_v[0], [3.0, 4.0, 6.0])
+    # id 7 appears exactly once — never again at distance 5.0
+    assert (out_i[0] == 7).sum() == 1
+
+
+# ---------------------------------------------------------------------------
+# engine parity: frontier == per-parent, bit for bit
+
+
+@pytest.mark.parametrize("width", [1, 2, ITOPK])
+def test_engine_parity_widths(index, data, width):
+    _, q = data
+    dv_f, di_f, dv_p, di_p = _both(index, q, width)
+    np.testing.assert_array_equal(di_f, di_p)
+    np.testing.assert_array_equal(dv_f, dv_p)
+
+
+@pytest.mark.parametrize("metric", ["inner_product", "euclidean"])
+def test_engine_parity_metrics(data, metric):
+    x, q = data
+    idx = cagra.build(x, cagra.CagraIndexParams(
+        intermediate_graph_degree=32, graph_degree=16, metric=metric))
+    dv_f, di_f, dv_p, di_p = _both(idx, q, 4)
+    np.testing.assert_array_equal(di_f, di_p)
+    np.testing.assert_array_equal(dv_f, dv_p)
+
+
+def test_engine_parity_capped_iterations(index, data):
+    _, q = data
+    dv_f, di_f, dv_p, di_p = _both(index, q, 2, max_iterations=3)
+    np.testing.assert_array_equal(di_f, di_p)
+    np.testing.assert_array_equal(dv_f, dv_p)
+
+
+@pytest.mark.parametrize("kind", ["bitset", "bitmap"])
+def test_engine_parity_filtered(index, data, kind):
+    x, q = data
+    rng = np.random.default_rng(3)
+    if kind == "bitset":
+        keep = rng.random(x.shape[0]) < 0.7
+        filt = Bitset.from_bool_array(keep)
+    else:
+        keep = rng.random((q.shape[0], x.shape[0])) < 0.7
+        filt = Bitmap(Bitset.from_bool_array(keep.reshape(-1)).words,
+                      *keep.shape)
+    dv_f, di_f = cagra.search(index, q, K, _params("frontier", 4),
+                              filter=filt)
+    dv_p, di_p = cagra.search(index, q, K, _params("per_parent", 4),
+                              filter=filt)
+    np.testing.assert_array_equal(np.asarray(di_f), np.asarray(di_p))
+    np.testing.assert_array_equal(np.asarray(dv_f), np.asarray(dv_p))
+    # filtered-out rows never appear (result-stage filter semantics)
+    ids = np.asarray(di_f)
+    if kind == "bitset":
+        valid = ids[ids >= 0]
+        assert keep[valid].all()
+    else:
+        for r in range(ids.shape[0]):
+            valid = ids[r][ids[r] >= 0]
+            assert keep[r, valid].all()
+
+
+def test_engine_parity_sharded(data, mesh8):
+    x, q = data
+    index = cagra.build_sharded(x, mesh8, cagra.CagraIndexParams(
+        intermediate_graph_degree=32, graph_degree=16))
+    dv_f, di_f = cagra.search_sharded(index, q, K, _params("frontier", 4),
+                                      mesh=mesh8)
+    dv_p, di_p = cagra.search_sharded(index, q, K, _params("per_parent", 4),
+                                      mesh=mesh8)
+    np.testing.assert_array_equal(np.asarray(di_f), np.asarray(di_p))
+    np.testing.assert_array_equal(np.asarray(dv_f), np.asarray(dv_p))
+
+
+def test_beam_ids_unique(index, data):
+    """The sorted-ring visited filter's whole job: the result can never
+    contain one node twice."""
+    _, q = data
+    for width in (1, 4):
+        _, ids = cagra.search(index, q, K, _params("frontier", width))
+        for row in np.asarray(ids):
+            valid = row[row >= 0]
+            assert len(set(valid.tolist())) == len(valid)
+
+
+# ---------------------------------------------------------------------------
+# steady state: shared executables + serving searcher
+
+
+def test_max_iterations_shares_executable(index, data):
+    """``max_iterations`` ≤ the auto count is a DEVICE-scalar cap change,
+    not a new program: after warming the auto config, a capped search
+    must neither retrace nor transfer."""
+    _, q = data
+    qd = jax.device_put(q)
+    p_auto = _params("frontier", 4)
+    d0, i0 = cagra.search(index, qd, K, p_auto)  # warm (auto iters)
+    jax.block_until_ready((d0, i0))
+    p_cap = dataclasses.replace(p_auto, max_iterations=2)
+    d1, i1 = cagra.search(index, qd, K, p_cap)   # warm the cap operand memo
+    jax.block_until_ready((d1, i1))
+    with TraceGuard() as tg:
+        d2, i2 = cagra.search(index, qd, K, p_cap)
+        d3, i3 = cagra.search(index, qd, K, p_auto)
+        jax.block_until_ready((d2, i2, d3, i3))
+    tg.assert_steady_state()
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i3))
+
+
+def test_searcher_bit_identical_and_mixed_shape_steady(index, data):
+    """Serving contract: ``searcher()``'s fn matches direct ``search()``
+    bit-for-bit, and mixed query shapes run steady-state after warmup."""
+    _, q = data
+    fn, operands = cagra.searcher(index, K, _params("frontier", 4))
+    shapes = [jax.device_put(q[:4]), jax.device_put(q[:32])]
+    for qd in shapes:  # warm every shape bucket
+        jax.block_until_ready(fn(qd, *operands))
+    with TraceGuard() as tg:
+        for _ in range(3):
+            for qd in shapes:
+                d, i = fn(qd, *operands)
+        jax.block_until_ready((d, i))
+    tg.assert_steady_state()
+    dv, di = cagra.search(index, np.asarray(q[:32]), K,
+                          _params("frontier", 4))
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(di))
+    np.testing.assert_array_equal(np.asarray(d), np.asarray(dv))
+
+
+def test_resolved_search_params_concretizes_auto(index):
+    p = cagra.resolved_search_params(
+        index, K, cagra.CagraSearchParams(itopk_size=0, search_width=0))
+    assert p.itopk_size >= K and p.search_width >= 1
+    assert p.search_width <= p.itopk_size
+    # explicit values pass through untouched
+    p2 = cagra.resolved_search_params(index, K, _params("frontier", 4))
+    assert (p2.itopk_size, p2.search_width) == (ITOPK, 4)
