@@ -1,0 +1,140 @@
+"""Mutation throughput A/B — online ``extend()`` vs rebuild-from-scratch.
+
+The mutable-lifecycle question: when a batch of new rows arrives, is the
+online insert path (fused slab-donating chunk steps, one executable per
+index shape) actually cheaper than rebuilding the index?  Measured per
+IVF family over a grid of insert-batch sizes:
+
+* **extend** — steady-state ``extend(index, batch)`` wall time (the
+  executable is pre-warmed by the timing harness; bit-identical results
+  are asserted in ``tests/test_mutation.py``, so this is pure
+  wall-clock);
+* **rebuild** — ``build()`` over the union corpus, the only alternative
+  an immutable index offers;
+* **delete** — ``mutation.delete`` of 1k ids (tombstone mask update;
+  O(mask), slab-free) and **compact** — rewriting the slabs after
+  tombstoning 30% of the corpus (the reclaim path a background
+  ``swap_index(build=...)`` runs).
+
+    python bench/mutation_throughput.py [--quick] [--cpu]
+
+Writes ``bench/MUTATION_<BACKEND>.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.expanduser("~/.cache/raft_tpu_jax"))
+
+import jax
+
+from _platform import pin_backend
+
+# MUST precede any backend use (see _platform.py)
+pin_backend(sys.argv)
+
+import time
+
+import numpy as np
+
+from _timing import sync, timeit
+from raft_tpu.neighbors import ivf_flat, ivf_pq, mutation
+
+QUICK = "--quick" in sys.argv
+ROWS = 20_000 if QUICK else 200_000
+DIM = 64
+N_LISTS = max(16, int(np.sqrt(ROWS)))
+BATCHES = (1024, 16384)
+REPS = 3
+
+
+def _build(family, x):
+    if family == "ivf_flat":
+        return ivf_flat.build(x, ivf_flat.IvfFlatIndexParams(
+            n_lists=N_LISTS, kmeans_n_iters=4))
+    return ivf_pq.build(x, ivf_pq.IvfPqIndexParams(
+        n_lists=N_LISTS, pq_dim=16, pq_bits=4, kmeans_n_iters=4,
+        store_recon=False))
+
+
+def _extend(family, idx, batch, ids):
+    mod = ivf_flat if family == "ivf_flat" else ivf_pq
+    return mod.extend(idx, batch, ids)
+
+
+def run() -> dict:
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((ROWS, DIM)).astype(np.float32)
+    results = []
+    for family in ("ivf_flat", "ivf_pq"):
+        idx = _build(family, x)
+        sync(idx.counts)
+        for b in BATCHES:
+            batch = rng.standard_normal((b, DIM)).astype(np.float32)
+            ids = np.arange(ROWS, ROWS + b)
+            # steady state: extend returns a NEW index (the caller's
+            # slabs survive via the COW first chunk), so repeated calls
+            # on the same base index time the same work
+            ext_s = timeit(lambda: _extend(family, idx, batch, ids),
+                           reps=REPS)
+            union = np.concatenate([x, batch], axis=0)
+            reb_s = timeit(lambda: _build(family, union), reps=REPS)
+            results.append({
+                "family": family, "rows": ROWS, "dim": DIM,
+                "n_lists": N_LISTS, "batch": b,
+                "extend_s": round(ext_s, 4),
+                "extend_rows_per_s": int(b / ext_s),
+                "rebuild_s": round(reb_s, 4),
+                "speedup_vs_rebuild": round(reb_s / ext_s, 1),
+            })
+            print(json.dumps(results[-1]), flush=True)
+        # tombstone + compact: mask update is O(mask); compact rewrites
+        # the slabs — cost swept over the dead fraction (the trigger knob)
+        dead = rng.permutation(ROWS)
+        sync(mutation.delete(idx, [0]).keep.words)  # warm the mask ops
+        t0 = time.perf_counter()
+        view = mutation.delete(idx, np.sort(dead[:1024]).astype(np.int32))
+        sync(view.keep.words)
+        delete_s = time.perf_counter() - t0
+        for frac in (0.1, 0.3, 0.5):
+            view = mutation.delete(
+                idx, np.sort(dead[:int(ROWS * frac)]).astype(np.int32))
+            sync(view.keep.words)
+            compact_s = timeit(lambda: mutation.compact(view), reps=REPS)
+            results.append({
+                "family": family, "rows": ROWS,
+                "delete_1k_s": round(delete_s, 4),
+                "tombstoned_frac": frac,
+                "compact_s": round(compact_s, 4),
+                "compact_rows_per_s": int(ROWS * (1 - frac) / compact_s),
+            })
+            print(json.dumps(results[-1]), flush=True)
+    out = {
+        "bench": "mutation_throughput",
+        "backend": jax.default_backend(),
+        "mode": "quick" if QUICK else "full",
+        "reps": REPS,
+        "note": "extend is the online-insert path (COW-first/donate-rest"
+                " fused chunk steps; bit-identical to rebuild per"
+                " tests/test_mutation.py); rebuild is the immutable"
+                " alternative; compact rewrites slabs after tombstoning"
+                " 30% of rows",
+        "results": results,
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        f"MUTATION_{jax.default_backend().upper()}.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    print(f"wrote {path}", flush=True)
+    return out
+
+
+if __name__ == "__main__":
+    run()

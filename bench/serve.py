@@ -20,6 +20,14 @@ Scale knobs (CPU smoke → TPU record):
   RAFT_BENCH_SERVE_CLIENTS   comma sweep           (default "1,2,4,8,16")
   RAFT_BENCH_SERVE_BUDGET_MS p95 latency budget    (default 50)
   RAFT_BENCH_SERVE_LADDER    comma bucket ladder   (default "1,8,64")
+  RAFT_BENCH_SERVE_SWAPS     swap-under-load phase: rebuild + swap the
+                             index this many times while the measured
+                             load runs; final JSON gains a "swap" dict
+                             (handoffs, drops during handoff, p95 in the
+                             window) asserting the zero-drop contract
+                             (default 0 = off)
+  RAFT_SERVE_FAULTS          arm the chaos injector (see serve.faults)
+                             for a smoke of the retry/degrade paths
 """
 
 from __future__ import annotations
@@ -54,6 +62,7 @@ CLIENTS = tuple(int(c) for c in
 BUDGET_MS = float(os.environ.get("RAFT_BENCH_SERVE_BUDGET_MS", 50))
 LADDER = tuple(int(b) for b in
                os.environ.get("RAFT_BENCH_SERVE_LADDER", "1,8,64").split(","))
+SWAPS = int(os.environ.get("RAFT_BENCH_SERVE_SWAPS", 0))
 
 # the mixed-shape request mix: point lookups dominate, small batches
 # common, bulk occasional — the traffic the bucket ladder is shaped for
@@ -110,6 +119,72 @@ def _sweep_point(srv, n_clients: int, seconds: float, rng_seed: int):
                  snap["rejected_deadline"] - lat0["rejected_deadline"]})
 
 
+def _swap_phase(srv, db, n_clients: int, n_swaps: int, seconds: float):
+    """Swap-under-load: keep a closed-loop client load running while the
+    index is rebuilt (rows permuted — same shapes, new generation) and
+    swapped ``n_swaps`` times.  Client-side latencies are collected so
+    the reported p95 covers exactly the handoff window; any client-visible
+    failure counts as a drop (the contract is zero)."""
+    stop = threading.Event()
+    lat_ms: list = []
+    drops = [0] * n_clients
+    lock = threading.Lock()
+    snap0 = srv.metrics.snapshot()
+    compiles0 = srv.cache.compiles
+
+    def client(j):
+        rng = np.random.default_rng(1000 + j)
+        while not stop.is_set():
+            rows = int(rng.choice(_SHAPES))
+            q = rng.standard_normal((rows, DIM)).astype(np.float32)
+            t0 = time.perf_counter()
+            try:
+                srv.submit(q, deadline_ms=10 * BUDGET_MS).result(timeout=30)
+                with lock:
+                    lat_ms.append(1e3 * (time.perf_counter() - t0))
+            except Exception:
+                drops[j] += 1
+
+    threads = [threading.Thread(target=client, args=(j,), daemon=True)
+               for j in range(n_clients)]
+    for t in threads:
+        t.start()
+    gap = seconds / max(1, n_swaps)
+    swap_s = []
+    rng = np.random.default_rng(99)
+    for _ in range(n_swaps):
+        time.sleep(gap / 2)
+        t0 = time.perf_counter()
+        new_index, _ = _build_index(db[rng.permutation(db.shape[0])])
+        build_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        srv.swap_index(new_index)
+        swap_s.append(time.perf_counter() - t0)
+        time.sleep(gap / 2)
+        print(json.dumps({"config": "serve_swap",
+                          "generation": srv.generation,
+                          "build_s": round(build_s, 2),
+                          "swap_s": round(swap_s[-1], 4)}), flush=True)
+    stop.set()
+    for t in threads:
+        t.join(timeout=60)
+    snap = srv.metrics.snapshot()
+    lat_ms.sort()
+    return {
+        "swaps": n_swaps,
+        "clients": n_clients,
+        "completed": snap["completed"] - snap0["completed"],
+        "dropped": sum(drops)
+        + snap["rejected_deadline"] - snap0["rejected_deadline"]
+        + snap["faulted_batches"] - snap0["faulted_batches"],
+        "p95_ms_during_handoff": round(
+            lat_ms[int(0.95 * (len(lat_ms) - 1))], 3) if lat_ms else None,
+        "swap_s_max": round(max(swap_s), 4) if swap_s else None,
+        "recompiles": srv.cache.compiles - compiles0,
+        "retries": snap["retries"] - snap0["retries"],
+    }
+
+
 def run(seconds: float = SECONDS, clients=CLIENTS) -> dict:
     """Build index, start server, sweep concurrency; returns the final
     result dict (also printed as the last JSON line)."""
@@ -140,6 +215,12 @@ def run(seconds: float = SECONDS, clients=CLIENTS) -> dict:
             print(json.dumps(point), flush=True)
             if p95 <= BUDGET_MS and qps > best["qps"]:
                 best = {"qps": qps, "p95_ms": p95, "clients": n}
+        swap = None
+        if SWAPS:
+            swap = _swap_phase(srv, db, best["clients"] or max(clients),
+                               SWAPS, seconds)
+            print(json.dumps({"config": "serve_swap_phase", **swap}),
+                  flush=True)
     finally:
         srv.stop()
 
@@ -156,6 +237,8 @@ def run(seconds: float = SECONDS, clients=CLIENTS) -> dict:
         "points": points,
         "serving_metrics": snap,
     }
+    if SWAPS:
+        final["swap"] = swap
     print(json.dumps(final), flush=True)
     return final
 

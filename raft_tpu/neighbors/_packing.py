@@ -28,7 +28,8 @@ __all__ = ["pack_lists", "chunked_queries", "chunked_filtered_queries",
            "prefetch_chunks_padded", "build_heartbeat",
            "chunked_shard_rows", "chunked_shard_trainsets",
            "blocked_probe_plan", "resolve_probe_block",
-           "resolve_chunk_rows", "resolve_cagra_search"]
+           "resolve_chunk_rows", "resolve_cagra_search",
+           "DEFAULT_INSERT_CHUNK", "host_rows", "staged_insert_chunks"]
 
 
 def prefetch_chunks(dataset, chunk_rows: int, ids=None):
@@ -208,8 +209,11 @@ def cached_by_id(cache: dict, obj, compute, bound: int = 256):
 
 
 def _max_source_id(ids) -> int:
-    """max(ids) — a build-time constant, memoized per id-array object."""
-    return cached_by_id(_max_id_cache, ids, lambda: int(jnp.max(ids)))  # jaxlint: disable=JX01 build-time constant, memoized per id-array object; never on the search path
+    """max(ids) — a per-index constant, memoized per id-array object.  The
+    transfer is explicit (``device_get``) so generation swaps that derive
+    a fresh searcher stay clean under ``transfer_guard("disallow")``."""
+    return cached_by_id(_max_id_cache, ids,
+                        lambda: int(jax.device_get(jnp.max(ids))))  # jaxlint: disable=JX01 per-index constant, memoized per id-array object; explicit transfer stays clean under transfer_guard
 
 
 def check_filter_covers_ids(keep, ids):
@@ -649,6 +653,48 @@ scatter_append = partial(jax.jit, static_argnames=("n_lists", "cap"),
                          donate_argnums=(0, 1))(_scatter_append_impl)
 scatter_append_copy = partial(jax.jit, static_argnames=("n_lists", "cap"))(
     _scatter_append_impl)
+
+
+#: fixed row bucket the online ``extend()`` paths pad every insert batch
+#: to — one chunk-step executable serves every insert size (zero
+#: steady-state retraces; the serve ladder's counterpart for writes)
+DEFAULT_INSERT_CHUNK = 1024
+
+
+def host_rows(a):
+    """Materialize a row batch on host as numpy — an EXPLICIT
+    ``device_get`` for jax arrays (passes
+    ``jax.transfer_guard("disallow")``), zero-copy for numpy/memmap."""
+    import numpy as np
+
+    if isinstance(a, jax.Array):
+        return np.asarray(jax.device_get(a))  # jaxlint: disable=JX01 explicit host staging: callers slice insert chunks on host before a non-blocking device_put
+    return np.asarray(a)
+
+
+def staged_insert_chunks(x, ids, chunk: int, dtype):
+    """Stage an in-memory insert batch as fixed-shape device chunks for
+    the online ``extend()`` streams: rows are host-padded to a multiple
+    of ``chunk`` with id −1 (pad rows never request a list, never consume
+    capacity — the fused chunk steps mask them), so ONE executable serves
+    every insert size.  ``device_put`` is an explicit transfer — the
+    consumer loop stays clean under ``jax.transfer_guard("disallow")``.
+
+    The streaming-build analog is :func:`prefetch_chunks_padded`; this
+    variant skips the read pipeline (the batch is already in memory) and
+    never clamps ``chunk`` to the batch size — the fixed shape IS the
+    zero-retrace contract."""
+    import numpy as np
+
+    n = x.shape[0]
+    total = -(-n // chunk) * chunk
+    xh = np.zeros((total, x.shape[1]), dtype)
+    xh[:n] = x
+    ih = np.full((total,), -1, np.int32)
+    ih[:n] = ids
+    for lo in range(0, total, chunk):
+        yield (jax.device_put(xh[lo:lo + chunk]),
+               jax.device_put(ih[lo:lo + chunk]))
 
 
 @partial(jax.jit, static_argnames=("shape", "fill", "dtype"))

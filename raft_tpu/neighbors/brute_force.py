@@ -394,7 +394,8 @@ def knn(
 
 def searcher(database, k: int, *, metric: str = "sqeuclidean",
              mode: str = "exact", tile: int = 8192, cand: int = 64,
-             cut: str = "exact", refine_precision: str = "highest"):
+             cut: str = "exact", refine_precision: str = "highest",
+             filter=None):
     """Uniform serving entry point (``raft_tpu.serve`` contract): returns
     ``(fn, operands)`` where ``fn(queries, *operands)`` produces the same
     ``(distances, indices)`` as :func:`knn` for these arguments — every
@@ -402,7 +403,15 @@ def searcher(database, k: int, *, metric: str = "sqeuclidean",
     and ``fn`` AOT-compiles via
     ``jax.jit(fn).lower(q_spec, *operands).compile()``.  Index state rides
     as operands (not closure constants) so one executable per query bucket
-    never embeds a copy of the database."""
+    never embeds a copy of the database.
+
+    ``filter``: optional shared prefilter (``core.Bitset`` / 1-D bools
+    over database rows, True = keep) — rides as one more operand so
+    tombstone deletes swap in a new mask without recompiling.  Per-query
+    bitmaps can't ride a fixed operand across variable-row buckets and
+    are rejected."""
+    from ._packing import as_keep_mask, sentinel_filtered_ids
+
     y = wrap_array(database, ndim=2, name="database")
     expects(k >= 1, "k must be >= 1")
     expects(k <= y.shape[0], f"k={k} exceeds database size {y.shape[0]}")
@@ -410,6 +419,26 @@ def searcher(database, k: int, *, metric: str = "sqeuclidean",
     expects(cut in ("exact", "approx"), f"unknown cut {cut!r}")
     expects(refine_precision in ("highest", "high"),
             f"unknown refine_precision {refine_precision!r}")
+    keep = as_keep_mask(filter, n=y.shape[0])
+    if keep is not None:
+        expects(keep.ndim == 1,
+                "serving filters are shared bitsets (1-D); per-query "
+                "bitmaps can't ride a fixed operand across buckets")
+        if mode == "fast":
+            c = int(max(cand, k))
+
+            def fn(q, yy, kp):
+                dv, di = _fast_knn_impl(q, yy, int(k), metric, c, 1024,
+                                        1024, kp, cut, refine_precision)
+                return dv, sentinel_filtered_ids(dv, di)
+        else:
+            t = int(min(tile, max(y.shape[0], 1)))
+
+            def fn(q, yy, kp):
+                dv, di = _knn_impl(q, yy, int(k), metric, t, kp)
+                return dv, sentinel_filtered_ids(dv, di)
+
+        return fn, (y, keep)
     if mode == "fast":
         c = int(max(cand, k))
         fn = lambda q, yy: _fast_knn_impl(q, yy, int(k), metric, c,

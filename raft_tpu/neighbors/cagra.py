@@ -71,7 +71,7 @@ class CagraIndexParams:
     graph_degree: int = 32
     metric: str = "sqeuclidean"
     build_algo: str = "brute_force"  # brute_force | ivf
-    # entry-point table size (see _build_routers); 0 = auto ≈ 2·√n.  The
+    # entry-point table size (see _build_routers); 0 = auto ≈ 4·√n.  The
     # table must out-number the dataset's natural regions or recall caps
     # at the covered fraction REGARDLESS of search effort (a 300k-row
     # 300-cluster probe plateaued at 0.49 with 150 routers — beam search
@@ -354,11 +354,15 @@ def build(dataset, params: Optional[CagraIndexParams] = None, *,
 
 
 def _auto_routers(n_routers: int, n: int) -> int:
-    """0 → ≈2·√n (the IVF n_lists heuristic: enough entries to out-number
-    the dataset's natural regions); every result is clamped to n (kmeans
-    cannot make more clusters than rows)."""
+    """0 → ≈4·√n; every result is clamped to n (kmeans cannot make more
+    clusters than rows).  The IVF n_lists heuristic (≈2·√n) undershoots
+    here: routers must *cover* every natural region, and kmeans merges
+    nearby regions when centroids are scarce (2·√8000 ≈ 179 entries over
+    200 well-separated clusters caps coverage near 0.85 regardless of
+    itopk).  Oversampling ~2× past the heuristic leaves headroom for
+    those collisions; the 128 floor keeps small-n behavior unchanged."""
     if n_routers <= 0:
-        return min(n, max(128, int(2 * np.sqrt(n))))
+        return min(n, max(128, int(4 * np.sqrt(n))))
     return min(n_routers, n)
 
 
@@ -399,7 +403,7 @@ def build_from_graph(dataset, knn_graph, graph_degree: int = 32,
                      metric: str = "sqeuclidean", n_routers: int = 0,
                      seed: int = 0) -> CagraIndex:
     """Build from a precomputed kNN graph (cuVS ``build`` overload parity).
-    ``n_routers=0`` = auto (≈2·√n, see :func:`_auto_routers`)."""
+    ``n_routers=0`` = auto (≈4·√n, see :func:`_auto_routers`)."""
     x = wrap_array(dataset, ndim=2, name="dataset")
     graph = optimize_graph(knn_graph, graph_degree)
     routers, router_nodes = _build_routers(
@@ -992,7 +996,8 @@ def search(index: CagraIndex, queries, k: int,
 
 
 def searcher(index: CagraIndex, k: int,
-             params: Optional[CagraSearchParams] = None, *, seed: int = 0):
+             params: Optional[CagraSearchParams] = None, *, seed: int = 0,
+             filter=None):
     """Uniform serving entry point (``raft_tpu.serve`` contract): returns
     ``(fn, operands)`` with ``fn(queries, *operands)`` equal to
     :func:`search` at the same ``seed``.  The PRNG key rides as an operand
@@ -1000,7 +1005,15 @@ def searcher(index: CagraIndex, k: int,
     serving batches stay row-identical to a direct call); dataset/graph
     and the dynamic iteration cap ride as operands so bucket executables
     share them (a ``max_iterations`` change within the compiled scan
-    length never recompiles)."""
+    length never recompiles).
+
+    ``filter``: optional shared prefilter (``core.Bitset`` / 1-D bools
+    over row numbers, True = keep) with :func:`search`'s beam-stage
+    semantics — rides as one more operand so tombstone deletes swap in a
+    new mask without recompiling.  Per-query bitmaps can't ride a fixed
+    operand across variable-row buckets and are rejected."""
+    from ._packing import as_keep_mask, sentinel_filtered_ids
+
     p = params or CagraSearchParams()
     expects(k >= 1, "k must be >= 1")
     itopk, width, iters, cap = _resolve_search(p, k, index.size)
@@ -1008,6 +1021,20 @@ def searcher(index: CagraIndex, k: int,
     metric = index.metric
     engine = _engine(p.search_impl)
     key = jax.random.PRNGKey(seed)
+    keep = as_keep_mask(filter, n=index.size)
+    if keep is not None:
+        expects(keep.ndim == 1,
+                "serving filters are shared bitsets (1-D); per-query "
+                "bitmaps can't ride a fixed operand across buckets")
+
+        def fn(q, dataset, graph, routers, router_nodes, kk, cap_dev, kp):
+            dv, di = engine(dataset, graph, routers, router_nodes, q, kk,
+                            cap_dev, int(k), itopk, width, iters, n_seeds,
+                            metric, kp)
+            return dv, sentinel_filtered_ids(dv, di)
+
+        return fn, (index.dataset, index.graph, index.router_centroids,
+                    index.router_nodes, key, _iters_cap(cap), keep)
 
     def fn(q, dataset, graph, routers, router_nodes, kk, cap_dev):
         return engine(dataset, graph, routers, router_nodes, q, kk,

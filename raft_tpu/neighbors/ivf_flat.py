@@ -164,16 +164,21 @@ def _coarse_train_chunked(dataset, p: IvfFlatIndexParams, n: int):
     return centroids
 
 
-@partial(jax.jit, static_argnames=("n_lists", "cap"), donate_argnums=(0, 1))
-def _flat_chunk_step(slabs, counts, centroids, xc, idc, *,
-                     n_lists: int, cap: int):
-    """ONE jitted, slab-donating program per chunk: masked capped
-    assignment against remaining room + scatter-append, fused so XLA sees
-    (and schedules) the whole chunk as a single dispatch — no host
-    round-trip for ``counts`` between the stages.  Pad rows (``idc < 0``,
-    from the fixed-shape tail padding) never request a list, never consume
-    capacity, and scatter-drop via label −1, so the padded stream is
-    bit-identical to the unpadded per-op loop."""
+def _flat_step_impl(slabs, counts, centroids, xc, idc, *,
+                    n_lists: int, cap: int):
+    """ONE fused program per chunk: masked capped assignment against
+    remaining room + scatter-append, fused so XLA sees (and schedules) the
+    whole chunk as a single dispatch — no host round-trip for ``counts``
+    between the stages.  Pad rows (``idc < 0``, from the fixed-shape tail
+    padding) never request a list, never consume capacity, and
+    scatter-drop via label −1, so the padded stream is bit-identical to
+    the unpadded per-op loop.
+
+    Two jitted forms: :func:`_flat_chunk_step` donates the slabs (build
+    loops own their buffers); :func:`_flat_chunk_step_cow` leaves the
+    inputs alive — the copy-on-write first step of the online
+    :func:`extend`, whose input slabs belong to the LIVE index a serving
+    snapshot may still be dispatching against."""
     from ..cluster.kmeans import _capped_assign_impl
     from ._packing import _scatter_append_impl
 
@@ -181,6 +186,12 @@ def _flat_chunk_step(slabs, counts, centroids, xc, idc, *,
     labels, _ = _capped_assign_impl(xc, centroids, cap - counts, valid)
     return _scatter_append_impl(slabs, counts, labels, (xc, idc),
                                 n_lists=n_lists, cap=cap)
+
+
+_flat_chunk_step = partial(jax.jit, static_argnames=("n_lists", "cap"),
+                           donate_argnums=(0, 1))(_flat_step_impl)
+_flat_chunk_step_cow = partial(jax.jit, static_argnames=("n_lists", "cap"))(
+    _flat_step_impl)
 
 
 def _stream_pipelined(dataset, centroids, p: IvfFlatIndexParams, n: int,
@@ -295,43 +306,71 @@ def _build_chunked_perop(dataset, params: Optional[IvfFlatIndexParams] = None,
     return IvfFlatIndex(centroids, data, ids_slab, counts, norms, p.metric)
 
 
-def extend(index: IvfFlatIndex, new_vectors, new_ids=None) -> IvfFlatIndex:
-    """Append vectors to existing lists (device-side, like cuVS extend).
+def extend(index: IvfFlatIndex, new_vectors, new_ids=None, *,
+           insert_chunk: int = 0) -> IvfFlatIndex:
+    """Online streaming insert (cuVS ``extend`` parity), rebuilt around
+    the chunked builder's fused slab-donating step.
 
-    The list slab is a static shape, so capacity grows when the new rows
-    overflow it (rebuild-the-slab, the padded-layout price of extend).
+    The insert batch is host-padded to a fixed ``insert_chunk`` row bucket
+    (0 = :data:`~._packing.DEFAULT_INSERT_CHUNK`; pad rows carry id −1 and
+    are masked out of assignment and capacity) and streamed through
+    :func:`_flat_chunk_step`: ONE jitted executable serves every insert
+    size, counts never leave the device between assign and scatter, and
+    the only host↔device crossings are the explicit per-chunk
+    ``device_put`` and one scalar spill check — the steady-state insert
+    path is zero-retrace / zero-implicit-transfer under
+    :class:`~raft_tpu.core.TraceGuard`.
+
+    Copy-on-write: the first chunk step is the non-donating
+    :func:`_flat_chunk_step_cow` (the source slabs may back a live serving
+    snapshot mid-dispatch), later chunks donate the fresh private buffers.
+    The source ``index`` stays fully usable after the call.
+
+    When the batch overflows list capacity the slab grows (a host-sized
+    static shape — the padded layout's rebuild price) with geometric
+    headroom and the stream re-runs from the untouched source slabs.
+    With capacity to spare, capped assignment degenerates to
+    nearest-centroid for every row, so extending is bit-identical (values
+    AND ids) to a from-scratch pack at the same centroids
+    (tests/test_mutation.py pins this).
     """
-    from ._packing import pack_lists
+    from ._packing import (DEFAULT_INSERT_CHUNK, host_rows,
+                           staged_insert_chunks)
 
-    x = wrap_array(new_vectors, ndim=2)
-    ids = (jnp.asarray(new_ids, jnp.int32) if new_ids is not None
-           else jnp.arange(index.size, index.size + x.shape[0], dtype=jnp.int32))
-    labels = jnp.argmin(sq_l2(x, index.centroids), axis=1).astype(jnp.int32)
-    added = jax.ops.segment_sum(
-        jnp.ones_like(labels), labels, num_segments=index.n_lists)
-    new_cap = max(index.list_cap, int(jnp.max(index.counts + added)))  # jaxlint: disable=JX01 slab capacity must be a host int at extend time (static shapes)
+    L, cap, d = index.n_lists, index.list_cap, index.dim
+    x = host_rows(new_vectors)
+    expects(x.ndim == 2 and x.shape[1] == d, "vector dim mismatch")
+    n_new = x.shape[0]
+    expects(n_new >= 1, "no rows to insert")
+    base = int(jax.device_get(jnp.sum(index.counts)))  # jaxlint: disable=JX01 one scalar sync per extend call: sizes auto-assigned ids and the spill check baseline
+    ids = (np.asarray(host_rows(new_ids), np.int32) if new_ids is not None
+           else np.arange(base, base + n_new, dtype=np.int32))
+    expects(ids.shape == (n_new,), "new_ids must be one id per row")
+    expects(int(ids.min()) >= 0, "source ids must be >= 0 (−1 is the pad)")
+    chunk = int(insert_chunk) or DEFAULT_INSERT_CHUNK
 
-    # pack the new rows into their own slab, then splice after the old rows
-    (nd, nids), ncounts = pack_lists(
-        labels, (x.astype(index.data.dtype), ids),
-        n_lists=index.n_lists, cap=new_cap, fills=(0.0, -1))
-    pad = new_cap - index.list_cap
-    data = jnp.concatenate(
-        [index.data, jnp.zeros((index.n_lists, pad, index.dim), index.data.dtype)],
-        axis=1) if pad else index.data
-    out_ids = jnp.concatenate(
-        [index.ids, jnp.full((index.n_lists, pad), -1, jnp.int32)], axis=1
-    ) if pad else index.ids
-    # shift each list's new rows to start at the old count: roll via gather
-    col = jnp.arange(new_cap)[None, :]
-    src = col - index.counts[:, None]           # position in the new slab
-    take = (src >= 0) & (src < ncounts[:, None])
-    src_safe = jnp.clip(src, 0, new_cap - 1)
-    nd_shift = jnp.take_along_axis(nd, src_safe[:, :, None], axis=1)
-    nids_shift = jnp.take_along_axis(nids, src_safe, axis=1)
-    data = jnp.where(take[:, :, None], nd_shift, data)
-    out_ids = jnp.where(take, nids_shift, out_ids)
-    counts = (index.counts + ncounts).astype(jnp.int32)
+    def stream(slabs, counts, slab_cap):
+        step = _flat_chunk_step_cow  # inputs may back a live snapshot
+        for xc, idc in staged_insert_chunks(x, ids, chunk, index.data.dtype):
+            slabs, counts = step(slabs, counts, index.centroids, xc, idc,
+                                 n_lists=L, cap=slab_cap)
+            step = _flat_chunk_step  # fresh private buffers: donate
+        return slabs, counts
+
+    (data, out_ids), counts = stream((index.data, index.ids), index.counts,
+                                     cap)
+    placed = int(jax.device_get(jnp.sum(counts))) - base  # jaxlint: disable=JX01 explicit spill check: one scalar per extend gates the rare slab-growth path
+    if placed < n_new:  # capacity exhausted — grow + re-run (rare)
+        xd = jnp.asarray(x.astype(index.data.dtype, copy=False))
+        labels = jnp.argmin(sq_l2(xd, index.centroids), axis=1)
+        added = jax.ops.segment_sum(jnp.ones_like(labels, jnp.int32),
+                                    labels, num_segments=L)
+        need = int(jnp.max(index.counts + added))  # jaxlint: disable=JX01 slab capacity must be a host int at extend time (static shapes)
+        new_cap = max(need, cap + (cap + 1) // 2)  # geometric headroom
+        pad = new_cap - cap
+        grown = (jnp.pad(index.data, ((0, 0), (0, pad), (0, 0))),
+                 jnp.pad(index.ids, ((0, 0), (0, pad)), constant_values=-1))
+        (data, out_ids), counts = stream(grown, index.counts, new_cap)
     norms = jnp.sum(data.astype(jnp.float32) ** 2, axis=2)
     return IvfFlatIndex(index.centroids, data, out_ids, counts, norms,
                         index.metric)
@@ -448,7 +487,7 @@ def search(index: IvfFlatIndex, queries, k: int,
 
 
 def searcher(index: IvfFlatIndex, k: int,
-             params: Optional[IvfFlatSearchParams] = None):
+             params: Optional[IvfFlatSearchParams] = None, *, filter=None):
     """Uniform serving entry point (``raft_tpu.serve`` contract): returns
     ``(fn, operands)`` with ``fn(queries, *operands)`` equal to
     :func:`search` for query batches up to ``params.query_chunk`` rows
@@ -456,8 +495,15 @@ def searcher(index: IvfFlatIndex, k: int,
     ``fn`` AOT-compiles via
     ``jax.jit(fn).lower(q_spec, *operands).compile()``; the index slabs
     ride as operands so bucket executables share them instead of baking
-    per-bucket constants."""
-    from ._packing import resolve_probe_block
+    per-bucket constants.
+
+    ``filter``: optional shared prefilter (``core.Bitset`` / 1-D bools
+    over source ids, True = keep) — rides as one more operand, so
+    tombstone deletes (:func:`raft_tpu.neighbors.mutation.delete`) swap
+    in a new mask without recompiling.  Per-query bitmaps can't ride a
+    fixed operand across variable-row buckets and are rejected."""
+    from ._packing import (as_keep_mask, check_filter_covers_ids,
+                           resolve_probe_block, sentinel_filtered_ids)
 
     p = params or IvfFlatSearchParams()
     expects(k >= 1, "k must be >= 1")
@@ -465,6 +511,20 @@ def searcher(index: IvfFlatIndex, k: int,
     probe_block = resolve_probe_block(p.probe_block, n_probes,
                                       index.list_cap, "ivf_flat")
     metric = index.metric
+    keep = as_keep_mask(filter)
+    if keep is not None:
+        expects(keep.ndim == 1,
+                "serving filters are shared bitsets (1-D); per-query "
+                "bitmaps can't ride a fixed operand across buckets")
+        check_filter_covers_ids(keep, index.ids)
+
+        def fn(q, centroids, data, ids, counts, norms, kp):
+            dv, di = _search_impl(centroids, data, ids, counts, norms, q,
+                                  int(k), n_probes, metric, kp, probe_block)
+            return dv, sentinel_filtered_ids(dv, di)
+
+        return fn, (index.centroids, index.data, index.ids, index.counts,
+                    index.norms, keep)
 
     def fn(q, centroids, data, ids, counts, norms):
         return _search_impl(centroids, data, ids, counts, norms, q,

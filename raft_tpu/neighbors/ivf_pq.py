@@ -417,48 +417,83 @@ def build(dataset, params: Optional[IvfPqIndexParams] = None, *,
     return index.with_packed_codes() if p.pack_codes else index
 
 
-def extend(index: IvfPqIndex, new_vectors, new_ids=None) -> IvfPqIndex:
-    """Append vectors to an existing index (cuVS ``extend`` parity): encode
-    against the trained centroids/codebooks and scatter-append into the
-    code slabs, growing list capacity when the new rows overflow it.  The
-    derived recon tier is rebuilt when the source index carried one."""
-    from ._packing import scatter_append_copy
+def extend(index: IvfPqIndex, new_vectors, new_ids=None, *,
+           insert_chunk: int = 0) -> IvfPqIndex:
+    """Online streaming insert (cuVS ``extend`` parity), rebuilt around
+    the chunked builder's fused slab-donating step.
 
-    x = wrap_array(new_vectors, ndim=2)
-    expects(x.shape[1] == index.dim, "vector dim mismatch")
+    The insert batch is host-padded to a fixed ``insert_chunk`` row bucket
+    (0 = :data:`~._packing.DEFAULT_INSERT_CHUNK`; pad rows carry id −1 and
+    are masked out of assignment and capacity) and streamed through
+    :func:`_pq_chunk_step` (capped assign → residual → PQ encode →
+    scatter-append, one dispatch per chunk): ONE jitted executable serves
+    every insert size, counts never leave the device between the stages,
+    and the only host↔device crossings are the explicit per-chunk
+    ``device_put`` and one scalar spill check — the steady-state insert
+    path is zero-retrace / zero-implicit-transfer under
+    :class:`~raft_tpu.core.TraceGuard`.
+
+    Copy-on-write: the first chunk step is the non-donating
+    :func:`_pq_chunk_step_cow` (the source slabs may back a live serving
+    snapshot mid-dispatch), later chunks donate the fresh private buffers;
+    the source ``index`` stays fully usable.  Derived tiers (hoisted-ADC
+    tables, recon slab) are re-derived through their fixed-shape jitted
+    rebuilds when the source index carried them.
+
+    Capacity overflow grows the slab (host-sized static shape) with
+    geometric headroom and re-runs the stream from the untouched source
+    slabs; with capacity to spare, capped assignment degenerates to
+    nearest-centroid, so extending is bit-identical (values AND ids) to a
+    from-scratch pack at the same centroids/codebooks
+    (tests/test_mutation.py pins this)."""
+    from ._packing import (DEFAULT_INSERT_CHUNK, host_rows,
+                           staged_insert_chunks)
+
     expects(not index.packed,
             "extend needs unpacked codes: index.with_unpacked_codes() "
             "first, then re-pack with with_packed_codes()")
     m = index.pq_dim
     L, cap = index.n_lists, index.list_cap
-    ids = (jnp.asarray(new_ids, jnp.int32) if new_ids is not None
-           else jnp.arange(index.size, index.size + x.shape[0],
-                           dtype=jnp.int32))
+    x = host_rows(new_vectors)
+    expects(x.ndim == 2 and x.shape[1] == index.dim, "vector dim mismatch")
+    n_new = x.shape[0]
+    expects(n_new >= 1, "no rows to insert")
+    base = int(jax.device_get(jnp.sum(index.counts)))  # jaxlint: disable=JX01 one scalar sync per extend call: sizes auto-assigned ids and the spill check baseline
+    ids = (np.asarray(host_rows(new_ids), np.int32) if new_ids is not None
+           else np.arange(base, base + n_new, dtype=np.int32))
+    expects(ids.shape == (n_new,), "new_ids must be one id per row")
+    expects(int(ids.min()) >= 0, "source ids must be >= 0 (−1 is the pad)")
+    chunk = int(insert_chunk) or DEFAULT_INSERT_CHUNK
+    dtype = index.centroids.dtype
 
-    # grow capacity so every new row fits its nearest list (static shape:
-    # computed on host from a plain assignment histogram); with capacity
-    # guaranteed, the capped assignment would degenerate to this argmin —
-    # so use it directly (same pattern as ivf_flat.extend)
-    labels = jnp.argmin(sq_l2(x, index.centroids), axis=1).astype(jnp.int32)
-    added = jax.ops.segment_sum(jnp.ones_like(labels, jnp.int32), labels,
-                                num_segments=L)
-    new_cap = max(cap, int(jnp.max(index.counts + added)))  # jaxlint: disable=JX01 slab capacity must be a host int at extend time (static shapes)
-    pad = new_cap - cap
-    codes = jnp.pad(index.codes, ((0, 0), (0, pad), (0, 0))) if pad else index.codes
-    cnorms = jnp.pad(index.code_norms, ((0, 0), (0, pad))) if pad else index.code_norms
-    slab_ids = (jnp.pad(index.ids, ((0, 0), (0, pad)), constant_values=-1)
-                if pad else index.ids)
+    def stream(slabs, counts, slab_cap):
+        step = _pq_chunk_step_cow  # inputs may back a live snapshot
+        for xc, idc in staged_insert_chunks(x, ids, chunk, dtype):
+            slabs, counts = step(slabs, counts, index.centroids,
+                                 index.codebooks, xc, idc,
+                                 n_lists=L, cap=slab_cap, m=m)
+            step = _pq_chunk_step  # fresh private buffers: donate
+        return slabs, counts
 
-    residuals = x - index.centroids[jnp.clip(labels, 0, L - 1)]
-    ch_codes, ch_norms = _encode(residuals, index.codebooks, m)
-    # non-donating form: the inputs may alias the LIVE source index's
-    # buffers (donation would delete them out from under `index`)
-    (codes, cnorms, slab_ids), counts = scatter_append_copy(
-        (codes, cnorms, slab_ids), index.counts, labels,
-        (ch_codes, ch_norms, ids), n_lists=L, cap=new_cap)
+    (codes, cnorms, slab_ids), counts = stream(
+        (index.codes, index.code_norms, index.ids), index.counts, cap)
+    placed = int(jax.device_get(jnp.sum(counts))) - base  # jaxlint: disable=JX01 explicit spill check: one scalar per extend gates the rare slab-growth path
+    if placed < n_new:  # capacity exhausted — grow + re-run (rare)
+        xd = jnp.asarray(x.astype(dtype, copy=False))
+        labels = jnp.argmin(sq_l2(xd, index.centroids), axis=1)
+        added = jax.ops.segment_sum(jnp.ones_like(labels, jnp.int32),
+                                    labels, num_segments=L)
+        need = int(jnp.max(index.counts + added))  # jaxlint: disable=JX01 slab capacity must be a host int at extend time (static shapes)
+        new_cap = max(need, cap + (cap + 1) // 2)  # geometric headroom
+        pad = new_cap - cap
+        grown = (jnp.pad(index.codes, ((0, 0), (0, pad), (0, 0))),
+                 jnp.pad(index.code_norms, ((0, 0), (0, pad))),
+                 jnp.pad(index.ids, ((0, 0), (0, pad)), constant_values=-1))
+        (codes, cnorms, slab_ids), counts = stream(grown, index.counts,
+                                                   new_cap)
     out = IvfPqIndex(index.centroids, index.codebooks, codes, cnorms,
                      slab_ids, counts, index.metric)
-    if index.adc_norms is not None:  # list capacity may have grown: rebuild
+    if index.adc_norms is not None:  # fixed-shape jitted rebuild
         out = out.with_adc_luts()
     return out.with_recon() if index.recon is not None else out
 
@@ -481,16 +516,20 @@ def _pq_train_chunked(dataset, p: IvfPqIndexParams, n: int, m: int, c: int):
     return centroids, codebooks
 
 
-@partial(jax.jit, static_argnames=("n_lists", "cap", "m"),
-         donate_argnums=(0, 1))
-def _pq_chunk_step(slabs, counts, centroids, codebooks, xc, idc, *,
-                   n_lists: int, cap: int, m: int):
-    """ONE jitted, slab-donating program per chunk: masked capped assign →
-    residual → PQ encode → scatter-append, fused so the whole chunk is a
-    single dispatch with no host round-trip for ``counts``.  Pad rows
-    (``idc < 0``) never request a list, never consume capacity, and
-    scatter-drop via label −1 — the padded fixed-shape stream is
-    bit-identical to the unpadded per-op loop."""
+def _pq_step_impl(slabs, counts, centroids, codebooks, xc, idc, *,
+                  n_lists: int, cap: int, m: int):
+    """ONE fused program per chunk: masked capped assign → residual → PQ
+    encode → scatter-append, fused so the whole chunk is a single dispatch
+    with no host round-trip for ``counts``.  Pad rows (``idc < 0``) never
+    request a list, never consume capacity, and scatter-drop via label −1
+    — the padded fixed-shape stream is bit-identical to the unpadded
+    per-op loop.
+
+    Two jitted forms: :func:`_pq_chunk_step` donates the slabs (build
+    loops own their buffers); :func:`_pq_chunk_step_cow` leaves the inputs
+    alive — the copy-on-write first step of the online :func:`extend`,
+    whose input slabs belong to the LIVE index a serving snapshot may
+    still be dispatching against."""
     from ..cluster.kmeans import _capped_assign_impl
     from ._packing import _scatter_append_impl
 
@@ -501,6 +540,13 @@ def _pq_chunk_step(slabs, counts, centroids, codebooks, xc, idc, *,
     return _scatter_append_impl(slabs, counts, labels,
                                 (ch_codes, ch_norms, idc),
                                 n_lists=n_lists, cap=cap)
+
+
+_pq_chunk_step = partial(jax.jit, static_argnames=("n_lists", "cap", "m"),
+                         donate_argnums=(0, 1))(_pq_step_impl)
+_pq_chunk_step_cow = partial(jax.jit,
+                             static_argnames=("n_lists", "cap", "m"))(
+    _pq_step_impl)
 
 
 def _pq_stream_pipelined(dataset, centroids, codebooks,
@@ -827,14 +873,21 @@ def search(index: IvfPqIndex, queries, k: int,
 
 
 def searcher(index: IvfPqIndex, k: int,
-             params: Optional[IvfPqSearchParams] = None):
+             params: Optional[IvfPqSearchParams] = None, *, filter=None):
     """Uniform serving entry point (``raft_tpu.serve`` contract): returns
     ``(fn, operands)`` with ``fn(queries, *operands)`` equal to
     :func:`search` for query batches up to ``params.query_chunk`` rows.
     Mode resolution matches :func:`search` (``auto`` → recon tier when the
     slab is materialized, LUT otherwise); index state rides as operands so
-    per-bucket executables never embed slab copies."""
-    from ._packing import resolve_probe_block
+    per-bucket executables never embed slab copies.
+
+    ``filter``: optional shared prefilter (``core.Bitset`` / 1-D bools
+    over source ids, True = keep) — rides as one more operand, so
+    tombstone deletes (:func:`raft_tpu.neighbors.mutation.delete`) swap
+    in a new mask without recompiling.  Per-query bitmaps can't ride a
+    fixed operand across variable-row buckets and are rejected."""
+    from ._packing import (as_keep_mask, check_filter_covers_ids,
+                           resolve_probe_block, sentinel_filtered_ids)
 
     p = params or IvfPqSearchParams()
     expects(k >= 1, "k must be >= 1")
@@ -843,6 +896,12 @@ def searcher(index: IvfPqIndex, k: int,
     probe_block = resolve_probe_block(p.probe_block, n_probes,
                                       index.list_cap, "ivf_pq")
     metric = index.metric
+    keep = as_keep_mask(filter)
+    if keep is not None:
+        expects(keep.ndim == 1,
+                "serving filters are shared bitsets (1-D); per-query "
+                "bitmaps can't ride a fixed operand across buckets")
+        check_filter_covers_ids(keep, index.ids)
     mode = p.mode
     if mode == "auto":
         mode = "recon" if index.recon is not None else "lut"
@@ -850,6 +909,16 @@ def searcher(index: IvfPqIndex, k: int,
         expects(index.recon is not None,
                 "mode='recon' needs the reconstruction slab — call "
                 "index.with_recon() (e.g. after load_index)")
+        if keep is not None:
+
+            def fn(q, centroids, recon, recon_norms, ids, kp):
+                dv, di = _search_recon_impl(centroids, recon, recon_norms,
+                                            ids, q, int(k), n_probes,
+                                            metric, kp, probe_block)
+                return dv, sentinel_filtered_ids(dv, di)
+
+            return fn, (index.centroids, index.recon, index.recon_norms,
+                        index.ids, keep)
 
         def fn(q, centroids, recon, recon_norms, ids):
             return _search_recon_impl(centroids, recon, recon_norms, ids,
@@ -860,6 +929,16 @@ def searcher(index: IvfPqIndex, k: int,
                     index.ids)
 
     index = index.with_adc_luts()  # once, here — operands carry the tables
+    if keep is not None:
+
+        def fn(q, centroids, codebooks, codes, adc_norms, ids, counts, kp):
+            dv, di = _search_lut_impl(centroids, codebooks, codes,
+                                      adc_norms, ids, counts, q, int(k),
+                                      n_probes, metric, kp, probe_block)
+            return dv, sentinel_filtered_ids(dv, di)
+
+        return fn, (index.centroids, index.codebooks, index.codes,
+                    index.adc_norms, index.ids, index.counts, keep)
 
     def fn(q, centroids, codebooks, codes, adc_norms, ids, counts):
         return _search_lut_impl(centroids, codebooks, codes, adc_norms,

@@ -22,7 +22,16 @@ index and owns
 * **serving metrics** (:mod:`.metrics`): queue depth, batch-fill ratio,
   p50/p95/p99 latency, timeout/reject counts, compile-cache hits —
   JSON-dumpable for the bench harness (``bench/serve.py``) and annotated
-  into profiler timelines via :mod:`raft_tpu.core.tracing`.
+  into profiler timelines via :mod:`raft_tpu.core.tracing`;
+* a **generation registry** (:mod:`.registry`): dispatch reads an
+  immutable copy-on-write snapshot and ``swap_index()`` publishes a
+  replacement atomically — pre-warmed and validated first, so a handoff
+  drops zero requests and (same-shaped generations) compiles nothing;
+* a **fault-injection chaos harness** (:mod:`.faults`): wedge / slow /
+  OOM / failed-swap faults armable per site (or via
+  ``RAFT_SERVE_FAULTS``), recovered by deadline-aware retry-with-backoff
+  (``ServerConfig.retry``) and transactional swap rollback — every
+  failure mode has a deterministic test (``tests/test_serve_lifecycle``).
 
 Served results are bit-identical to a direct index ``search()``: every
 index family exposes a uniform ``searcher()`` entry point returning a
@@ -41,11 +50,14 @@ True
 """
 
 from .admission import (AdmissionController, AdmissionPolicy,
-                        DeadlineExceeded, QueueFull, ServeError)
+                        DeadlineExceeded, QueueFull, RetryPolicy, ServeError)
 from .bucketing import DEFAULT_LADDER, bucket_for, normalize_ladder
 from .cache import ExecutableCache
+from .faults import (TRANSIENT_FAULTS, DeviceOOM, FaultError, FaultInjector,
+                     SwapFailed, WedgedDevice)
 from .metrics import ServingMetrics
-from .searchers import family_of, make_searcher
+from .registry import Generation, IndexRegistry
+from .searchers import family_of, make_searcher, unwrap_tombstones
 from .server import SearchServer, ServerConfig
 
 __all__ = [
@@ -55,12 +67,22 @@ __all__ = [
     "ServingMetrics",
     "AdmissionPolicy",
     "AdmissionController",
+    "RetryPolicy",
     "ServeError",
     "QueueFull",
     "DeadlineExceeded",
+    "FaultError",
+    "WedgedDevice",
+    "DeviceOOM",
+    "SwapFailed",
+    "TRANSIENT_FAULTS",
+    "FaultInjector",
+    "Generation",
+    "IndexRegistry",
     "DEFAULT_LADDER",
     "bucket_for",
     "normalize_ladder",
     "family_of",
     "make_searcher",
+    "unwrap_tombstones",
 ]

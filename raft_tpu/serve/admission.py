@@ -20,7 +20,7 @@ from typing import Tuple
 from ..core.errors import RaftError, expects
 
 __all__ = ["ServeError", "QueueFull", "DeadlineExceeded",
-           "AdmissionPolicy", "AdmissionController"]
+           "AdmissionPolicy", "AdmissionController", "RetryPolicy"]
 
 
 class ServeError(RaftError):
@@ -33,6 +33,31 @@ class QueueFull(ServeError):
 
 class DeadlineExceeded(ServeError):
     """Request rejected: its deadline passed before dispatch."""
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Backoff schedule for *transient* faults (``faults.TRANSIENT_FAULTS``:
+    wedged device, device OOM).  Retries are deadline-aware — the server
+    stops retrying a batch once the next backoff would outlive the
+    earliest deadline in it, rejecting instead of burning the budget."""
+
+    max_retries: int = 2
+    backoff_ms: float = 5.0
+    multiplier: float = 2.0
+    max_backoff_ms: float = 100.0
+
+    def __post_init__(self):
+        expects(self.max_retries >= 0, "max_retries must be >= 0")
+        expects(self.backoff_ms >= 0, "backoff_ms must be >= 0")
+        expects(self.multiplier >= 1.0, "multiplier must be >= 1.0")
+        expects(self.max_backoff_ms >= self.backoff_ms,
+                "max_backoff_ms must be >= backoff_ms")
+
+    def backoff_s(self, attempt: int) -> float:
+        """Sleep before retry ``attempt`` (0-based), in seconds."""
+        ms = self.backoff_ms * (self.multiplier ** max(0, int(attempt)))
+        return min(ms, self.max_backoff_ms) / 1e3
 
 
 @dataclasses.dataclass(frozen=True)

@@ -27,10 +27,14 @@ __all__ = ["ExecutableCache"]
 class ExecutableCache:
     """Thread-safe compile-once cache of AOT-lowered search executables.
 
-    ``get(key, builder)`` returns ``(compiled, operands)``; ``builder`` is
+    ``get(key, builder)`` returns the compiled executable; ``builder`` is
     only invoked on a miss and must return ``(fn, operands, q_spec)``
     where ``fn(queries, *operands)`` is jit-traceable and ``q_spec`` is a
-    ``jax.ShapeDtypeStruct`` for the padded query bucket.  Compilation
+    ``jax.ShapeDtypeStruct`` for the padded query bucket.  Only the
+    *compiled program* is cached — operands are generation state the
+    server owns (``SearchServer._parts``), so an index swap to a
+    same-shaped generation reuses every executable (the key carries the
+    operand scope, shapes + dtypes, not the arrays).  Compilation
     happens under the cache lock — the single-writer discipline that makes
     the compile counter an exact recompilation census (the property the
     serve guard test asserts).
@@ -61,9 +65,8 @@ class ExecutableCache:
                 compiled = jax.jit(fn).lower(q_spec, *operands).compile()
             self.compile_s += time.perf_counter() - t0
             self.compiles += 1
-            entry = (compiled, operands)
-            self._entries[key] = entry
-            return entry
+            self._entries[key] = compiled
+            return compiled
 
     def contains(self, key) -> bool:
         with self._lock:

@@ -1,0 +1,150 @@
+"""Fault injection — deterministic chaos for the serving runtime.
+
+The failure modes the bench rounds actually hit (BENCH_r04/r05: wedged
+TPU probes; bench.py's FAKE_WEDGE tiers) become *injectable* here, so
+every recovery path in :mod:`.server` has a deterministic test instead
+of a bench anecdote:
+
+* ``wedge``  — the accelerator call raises :class:`WedgedDevice`
+  (transient: the dispatch retry loop backs off and re-issues);
+* ``slow``   — the call completes after an injected delay (exercises
+  per-request deadlines and late-completion accounting);
+* ``oom``    — raises :class:`DeviceOOM` (transient — a background
+  build/extend retries, a dispatch retries after backoff);
+* ``fail``   — raises :class:`FaultError` (terminal: a generation swap
+  wrapping it surfaces :class:`SwapFailed` and keeps the old
+  generation).
+
+A :class:`FaultInjector` is armed per *site* (``"execute"``, ``"swap"``,
+``"extend"``) with a finite fire count, so tests express "the first two
+dispatches wedge, the third succeeds" exactly.  The server calls
+:meth:`FaultInjector.fire` at each site; an unarmed injector is a no-op
+(and the default), so production pays one dict lookup per dispatch.
+
+``RAFT_SERVE_FAULTS="site:kind[:times[:delay_ms]],..."`` arms an
+injector from the environment — the chaos-smoke hook for
+``bench/serve.py`` / ``scripts/tpu_jobs_*.sh``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from .admission import ServeError
+
+__all__ = ["FaultError", "WedgedDevice", "DeviceOOM", "SwapFailed",
+           "TRANSIENT_FAULTS", "FaultInjector"]
+
+
+class FaultError(ServeError):
+    """An injected (or injected-equivalent) runtime fault."""
+
+
+class WedgedDevice(FaultError):
+    """The accelerator stopped answering (the BENCH_r04/r05 probe-timeout
+    mode).  Transient: retry with backoff."""
+
+
+class DeviceOOM(FaultError):
+    """Device allocation failed (e.g. during a background extend).
+    Transient: retry — the failed attempt's buffers are freed."""
+
+
+class SwapFailed(ServeError):
+    """A generation swap did not happen; the previous generation is still
+    serving.  Raised by ``SearchServer.swap_index`` around validation or
+    build failures — ``__cause__`` holds the original error."""
+
+
+#: Fault types the dispatch/build retry loops may re-attempt.  Anything
+#: else propagates immediately (retrying a logic error just burns the
+#: deadline).
+TRANSIENT_FAULTS = (WedgedDevice, DeviceOOM)
+
+_KINDS = ("wedge", "slow", "oom", "fail")
+_SITES = ("execute", "swap", "extend")
+
+
+class FaultInjector:
+    """Armable fault source, one per server; thread-safe.
+
+    ``arm(site, kind, times=n, delay_ms=d)`` queues ``n`` firings of
+    ``kind`` at ``site``; each :meth:`fire` consumes one.  ``fired``
+    counts consumed faults per (site, kind) — tests assert recovery
+    *happened* (e.g. 2 wedges fired AND the request completed), not just
+    absence of a crash."""
+
+    def __init__(self, sleep=time.sleep) -> None:
+        self._lock = threading.Lock()
+        self._armed: dict = {}     # site -> list of (kind, delay_ms)
+        self.fired: dict = {}      # (site, kind) -> count
+        self._sleep = sleep
+
+    @classmethod
+    def from_env(cls, spec: Optional[str] = None, *,
+                 sleep=time.sleep) -> "FaultInjector":
+        """Build from ``RAFT_SERVE_FAULTS`` (or an explicit spec string):
+        ``"execute:wedge:2,swap:fail"`` arms two wedges on dispatch and
+        one failed swap.  Empty/missing spec → unarmed injector."""
+        import os
+
+        inj = cls(sleep=sleep)
+        spec = os.environ.get("RAFT_SERVE_FAULTS", "") if spec is None \
+            else spec
+        for part in filter(None, (p.strip() for p in spec.split(","))):
+            bits = part.split(":")
+            site, kind = bits[0], bits[1]
+            times = int(bits[2]) if len(bits) > 2 else 1
+            delay = float(bits[3]) if len(bits) > 3 else 0.0
+            inj.arm(site, kind, times=times, delay_ms=delay)
+        return inj
+
+    def arm(self, site: str, kind: str, *, times: int = 1,
+            delay_ms: float = 0.0) -> "FaultInjector":
+        from ..core.errors import expects
+
+        expects(site in _SITES, f"unknown fault site {site!r} ({_SITES})")
+        expects(kind in _KINDS, f"unknown fault kind {kind!r} ({_KINDS})")
+        expects(times >= 1, "times must be >= 1")
+        with self._lock:
+            self._armed.setdefault(site, []).extend(
+                [(kind, float(delay_ms))] * int(times))
+        return self
+
+    def disarm(self, site: Optional[str] = None) -> None:
+        with self._lock:
+            if site is None:
+                self._armed.clear()
+            else:
+                self._armed.pop(site, None)
+
+    def pending(self, site: str) -> int:
+        with self._lock:
+            return len(self._armed.get(site, ()))
+
+    def fire(self, site: str) -> None:
+        """Consume and enact the next armed fault at ``site`` (no-op when
+        unarmed).  ``slow`` sleeps through the injected ``sleep`` (a fake
+        clock's sleep in tests); the rest raise."""
+        with self._lock:
+            queue = self._armed.get(site)
+            if not queue:
+                return
+            kind, delay_ms = queue.pop(0)
+            key = (site, kind)
+            self.fired[key] = self.fired.get(key, 0) + 1
+        if kind == "slow":
+            self._sleep(delay_ms / 1e3)
+            return
+        if kind == "wedge":
+            raise WedgedDevice(f"injected wedge at {site!r}")
+        if kind == "oom":
+            raise DeviceOOM(f"injected OOM at {site!r}")
+        raise FaultError(f"injected failure at {site!r}")
+
+    def fired_count(self, site: str, kind: Optional[str] = None) -> int:
+        with self._lock:
+            return sum(n for (s, kd), n in self.fired.items()
+                       if s == site and (kind is None or kd == kind))

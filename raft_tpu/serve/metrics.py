@@ -41,6 +41,10 @@ class ServingMetrics:
         self.batches = 0
         self.real_rows = 0           # query rows carried by requests
         self.padded_rows = 0         # bucket rows dispatched (>= real_rows)
+        self.swaps = 0               # generation handoffs completed
+        self.failed_swaps = 0        # swaps rolled back (old gen kept)
+        self.retries = 0             # dispatch retries after transient faults
+        self.faulted_batches = 0     # batches rejected with retries exhausted
         self.degrade_dispatches: dict = {}  # level -> batch count
 
     def count(self, field: str, n: int = 1) -> None:
@@ -77,6 +81,10 @@ class ServingMetrics:
                 "batches": self.batches,
                 "real_rows": self.real_rows,
                 "padded_rows": self.padded_rows,
+                "swaps": self.swaps,
+                "failed_swaps": self.failed_swaps,
+                "retries": self.retries,
+                "faulted_batches": self.faulted_batches,
                 "batch_fill_ratio": round(fill, 4),
                 "degrade_dispatches": {str(k): v for k, v in
                                        sorted(self.degrade_dispatches.items())},

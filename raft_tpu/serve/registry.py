@@ -1,0 +1,70 @@
+"""Generation-aware copy-on-write index registry.
+
+The zero-downtime-handoff primitive: the server dispatches against an
+immutable :class:`Generation` snapshot while a replacement builds in the
+background, then :meth:`IndexRegistry.swap` makes the new generation
+current in one reference assignment.
+
+Why this is already copy-on-write: every index here is a frozen pytree of
+device arrays — "mutation" (extend/delete/compact) returns a NEW index
+sharing unchanged slabs with the old one.  So a snapshot is just a
+reference, and in-flight dispatches that captured the old generation's
+operands keep its arrays alive until they resolve (the GC is the drain
+barrier) — zero dropped requests, no locking on the dispatch path beyond
+one attribute read.
+
+Executable reuse across generations is the cache's job: bucket keys
+include only the operand *scope* (shapes + dtypes), so a same-shaped new
+generation reuses every compiled program — zero steady-state recompiles
+across swaps (see ``SearchServer._compiled``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any
+
+__all__ = ["Generation", "IndexRegistry"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Generation:
+    """One immutable snapshot: the index (or ``mutation.Tombstoned``
+    view) plus its monotonically increasing generation number."""
+
+    index: Any
+    gen_id: int
+
+
+class IndexRegistry:
+    """Holds the current :class:`Generation`; swaps are atomic.
+
+    ``current`` is a single attribute read (Python reference assignment
+    is atomic), so the dispatch path never takes the lock — the lock only
+    serializes writers, keeping ``gen_id`` strictly increasing when
+    several background builders race."""
+
+    def __init__(self, index) -> None:
+        self._lock = threading.Lock()
+        self._current = Generation(index, 0)
+        self.swaps = 0
+
+    @property
+    def current(self) -> Generation:
+        return self._current
+
+    @property
+    def gen_id(self) -> int:
+        return self._current.gen_id
+
+    def swap(self, new_index) -> Generation:
+        """Install ``new_index`` as the next generation and return it.
+        Validation belongs to the caller (``SearchServer.swap_index``
+        checks family/dim/dtype compatibility and wraps failures in
+        ``faults.SwapFailed`` *before* calling this)."""
+        with self._lock:
+            gen = Generation(new_index, self._current.gen_id + 1)
+            self._current = gen
+            self.swaps += 1
+            return gen

@@ -26,7 +26,8 @@ import numpy as np
 from ..core.errors import expects
 
 __all__ = ["BruteForceSearchParams", "family_of", "make_searcher",
-           "index_dim", "index_size", "query_dtype_of"]
+           "index_dim", "index_size", "query_dtype_of",
+           "unwrap_tombstones"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -43,12 +44,25 @@ class BruteForceSearchParams:
     refine_precision: str = "highest"
 
 
+def unwrap_tombstones(index):
+    """Split a ``mutation.Tombstoned`` view into ``(index, keep_bitset)``
+    — ``(index, None)`` for a plain index.  The serve layer does this at
+    every entry point so tombstoned views serve transparently (the mask
+    becomes the searcher's shared prefilter operand)."""
+    from ..neighbors.mutation import Tombstoned
+
+    if isinstance(index, Tombstoned):
+        return index.index, index.keep
+    return index, None
+
+
 def family_of(index) -> str:
     """Index family name for cache keys / metrics labels."""
     from ..neighbors.cagra import CagraIndex
     from ..neighbors.ivf_flat import IvfFlatIndex
     from ..neighbors.ivf_pq import IvfPqIndex
 
+    index, _ = unwrap_tombstones(index)
     if isinstance(index, IvfFlatIndex):
         return "ivf_flat"
     if isinstance(index, IvfPqIndex):
@@ -58,16 +72,19 @@ def family_of(index) -> str:
     if isinstance(index, (jax.Array, np.ndarray)) and index.ndim == 2:
         return "brute_force"
     raise TypeError(f"no serving searcher for {type(index).__name__}; "
-                    "expected IvfFlatIndex/IvfPqIndex/CagraIndex or a 2-D "
-                    "database array")
+                    "expected IvfFlatIndex/IvfPqIndex/CagraIndex, a "
+                    "mutation.Tombstoned view of one, or a 2-D database "
+                    "array")
 
 
 def index_dim(index) -> int:
+    index, _ = unwrap_tombstones(index)
     return int(index.shape[1]) if family_of(index) == "brute_force" \
         else int(index.dim)
 
 
 def index_size(index) -> int:
+    index, _ = unwrap_tombstones(index)
     return int(index.shape[0]) if family_of(index) == "brute_force" \
         else int(index.size)
 
@@ -76,6 +93,7 @@ def query_dtype_of(index):
     """The dtype warm-up should precompile for — the dtype the stored
     vectors expect queries in (requests with another dtype compile their
     own bucket set on first use)."""
+    index, _ = unwrap_tombstones(index)
     fam = family_of(index)
     if fam == "brute_force":
         return jax.numpy.asarray(index[:1]).dtype if isinstance(
@@ -90,7 +108,7 @@ def _scaled(value: int, scale: float, floor: int) -> int:
 
 
 def make_searcher(index, k: int, params=None, *, effort_scale: float = 1.0,
-                  seed: int = 0):
+                  seed: int = 0, filter=None):
     """Build the ``(fn, operands)`` searcher for ``index`` at one
     degradation point.  ``effort_scale`` in (0, 1] multiplies the
     family's effort knob; 1.0 reproduces direct ``search()`` exactly
@@ -100,9 +118,21 @@ def make_searcher(index, k: int, params=None, *, effort_scale: float = 1.0,
     through unchanged.  In particular the IVF families' ``probe_block``
     (blocked probe scan; 0 = auto-tuned) reaches the baked executable
     as given: it changes wall-clock only, never results, so degradation
-    ladders keep one blocking choice across all effort levels."""
+    ladders keep one blocking choice across all effort levels.
+
+    A ``mutation.Tombstoned`` view is unwrapped here: its keep-mask
+    becomes the family searcher's shared ``filter=`` operand (deleted
+    ids report as −1/±inf sentinels, never as results), composed with an
+    explicit ``filter`` by AND when both are present."""
     expects(0.0 < effort_scale <= 1.0,
             f"effort_scale must be in (0, 1], got {effort_scale}")
+    index, keep = unwrap_tombstones(index)
+    if keep is not None and filter is not None:
+        from ..neighbors.mutation import _combined_keep
+
+        filter = _combined_keep(keep, filter)
+    elif keep is not None:
+        filter = keep
     fam = family_of(index)
     if fam == "brute_force":
         from ..neighbors import brute_force
@@ -112,7 +142,7 @@ def make_searcher(index, k: int, params=None, *, effort_scale: float = 1.0,
             else p.cand
         return brute_force.searcher(
             index, k, metric=p.metric, mode=p.mode, tile=p.tile, cand=cand,
-            cut=p.cut, refine_precision=p.refine_precision)
+            cut=p.cut, refine_precision=p.refine_precision, filter=filter)
     if fam == "ivf_flat":
         from ..neighbors import ivf_flat
 
@@ -121,7 +151,7 @@ def make_searcher(index, k: int, params=None, *, effort_scale: float = 1.0,
             p = dataclasses.replace(
                 p, n_probes=_scaled(min(p.n_probes, index.n_lists),
                                     effort_scale, 1))
-        return ivf_flat.searcher(index, k, p)
+        return ivf_flat.searcher(index, k, p, filter=filter)
     if fam == "ivf_pq":
         from ..neighbors import ivf_pq
 
@@ -130,7 +160,7 @@ def make_searcher(index, k: int, params=None, *, effort_scale: float = 1.0,
             p = dataclasses.replace(
                 p, n_probes=_scaled(min(p.n_probes, index.n_lists),
                                     effort_scale, 1))
-        return ivf_pq.searcher(index, k, p)
+        return ivf_pq.searcher(index, k, p, filter=filter)
     from ..neighbors import cagra
 
     # resolve 0 = auto itopk/width from the tuned table FIRST — scaling
@@ -139,4 +169,4 @@ def make_searcher(index, k: int, params=None, *, effort_scale: float = 1.0,
     if effort_scale < 1.0:
         p = dataclasses.replace(
             p, itopk_size=_scaled(max(p.itopk_size, k), effort_scale, k))
-    return cagra.searcher(index, k, p, seed=seed)
+    return cagra.searcher(index, k, p, seed=seed, filter=filter)

@@ -27,11 +27,14 @@ from ..core import tracing
 from ..core.errors import expects
 from ..core.logging import default_logger
 from .admission import (AdmissionController, AdmissionPolicy,
-                        DeadlineExceeded, QueueFull, ServeError)
+                        DeadlineExceeded, QueueFull, RetryPolicy,
+                        ServeError)
 from .batcher import Request, SplitSink, plan_batch
 from .bucketing import DEFAULT_LADDER, normalize_ladder, pad_rows, split_rows
 from .cache import ExecutableCache
+from .faults import TRANSIENT_FAULTS, FaultInjector, SwapFailed
 from .metrics import ServingMetrics
+from .registry import IndexRegistry
 from .searchers import (family_of, index_dim, index_size, make_searcher,
                         query_dtype_of)
 
@@ -46,7 +49,8 @@ class ServerConfig:
     holds a non-full batch open for more arrivals; ``warm_levels``: how
     many degradation levels ``start()`` precompiles (level 0 is the
     bit-identical full-quality tier; deeper levels compile on first
-    pressure unless warmed here).
+    pressure unless warmed here); ``retry``: backoff schedule for
+    transient dispatch faults (wedge/OOM — see :mod:`.faults`).
     """
 
     ladder: Tuple[int, ...] = DEFAULT_LADDER
@@ -57,6 +61,7 @@ class ServerConfig:
     degrade_effort_scales: Tuple[float, ...] = (1.0, 0.5, 0.25)
     warm_levels: int = 1
     latency_window: int = 4096
+    retry: RetryPolicy = RetryPolicy()
 
     def __post_init__(self):
         expects(len(self.degrade_effort_scales)
@@ -83,12 +88,22 @@ class SearchServer:
     ``clock`` (monotonic seconds) is injectable for deterministic tests;
     the dispatch thread's *waits* always use real time, so a fake clock
     only makes sense with manual ``step()`` driving.
+
+    The index lives in a generation registry (:mod:`.registry`):
+    :meth:`swap_index` installs a replacement with zero dropped requests
+    and — for a same-shaped generation — zero recompiles (executable
+    cache keys carry operand shapes, not the arrays).  ``faults`` is an
+    optional :class:`.faults.FaultInjector` (default: armed from
+    ``RAFT_SERVE_FAULTS`` if set, else inert); ``sleep`` injects the
+    retry-backoff sleeper for deterministic fault tests.
     """
 
     def __init__(self, index, k: int = 10, params=None, *,
                  config: Optional[ServerConfig] = None,
-                 clock=time.monotonic, seed: int = 0, res=None) -> None:
-        self.index = index
+                 clock=time.monotonic, seed: int = 0, res=None,
+                 faults: Optional[FaultInjector] = None,
+                 sleep=time.sleep) -> None:
+        self._registry = IndexRegistry(index)
         self.family = family_of(index)
         expects(1 <= k <= index_size(index),
                 f"k={k} out of range for index of {index_size(index)} rows")
@@ -106,11 +121,27 @@ class SearchServer:
             max_queue=self.config.max_queue,
             default_deadline_ms=self.config.default_deadline_ms,
             degrade_queue_fractions=self.config.degrade_queue_fractions))
+        self.faults = faults if faults is not None \
+            else FaultInjector.from_env(sleep=sleep)
+        self._sleep = sleep
         self._log = default_logger() if res is None else None
         self._cond = threading.Condition()
+        self._parts_lock = threading.Lock()
+        self._searchers: dict = {}   # (gen_id, k, level) -> (fn, operands)
         self._pending: list = []
         self._thread: Optional[threading.Thread] = None
         self._running = False
+
+    @property
+    def index(self):
+        """The currently-serving generation's index (immutable snapshot —
+        read it once per use; a concurrent swap replaces the reference,
+        never the object)."""
+        return self._registry.current.index
+
+    @property
+    def generation(self) -> int:
+        return self._registry.gen_id
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -252,41 +283,96 @@ class SearchServer:
         self._execute(batch, bucket, level)
         return len(expired) + len(batch)
 
-    def _builder(self, bucket: int, k: int, dtype, level: int):
+    def _parts(self, k: int, level: int, gen=None):
+        """(fn, operands) for one (generation, k, level) — memoized so the
+        steady-state dispatch path never re-runs ``make_searcher`` (which
+        would rebuild keep-mask/LUT operands per batch).  Older
+        generations' entries are purged on first use of a newer one; any
+        in-flight dispatch holds its own operand references, so the old
+        arrays live exactly as long as requests that captured them."""
+        gen = self._registry.current if gen is None else gen
+        key = (gen.gen_id, int(k), int(level))
+        with self._parts_lock:
+            hit = self._searchers.get(key)
+            if hit is not None:
+                return hit
+        scale = self.config.degrade_effort_scales[level]
+        fn, operands = make_searcher(gen.index, k, self.params,
+                                     effort_scale=scale, seed=self.seed)
+        with self._parts_lock:
+            current = self._registry.gen_id
+            for old in [kk for kk in self._searchers if kk[0] < current]:
+                del self._searchers[old]
+            self._searchers.setdefault(key, (fn, operands))
+            return self._searchers[key]
+
+    @staticmethod
+    def _operand_scope(operands):
+        """Shapes + dtypes of the searcher operands — the generation-
+        INVARIANT part of an executable's identity.  Cache keys carry
+        this instead of the arrays, so a swapped-in generation with the
+        same slab shapes reuses every compiled program."""
+        return tuple((tuple(a.shape), str(a.dtype)) for a in operands)
+
+    def _compiled(self, bucket: int, k: int, dtype, level: int, gen=None):
+        fn, operands = self._parts(k, level, gen)
+        key = (self.family, int(bucket), int(k), str(jnp.dtype(dtype)),
+               int(level), self._operand_scope(operands))
+
         def build():
-            scale = self.config.degrade_effort_scales[level]
-            fn, operands = make_searcher(self.index, k, self.params,
-                                         effort_scale=scale, seed=self.seed)
             spec = jax.ShapeDtypeStruct((bucket, self._dim), dtype)
             return fn, operands, spec
-        return build
 
-    def _compiled(self, bucket: int, k: int, dtype, level: int):
-        key = (self.family, int(bucket), int(k), str(jnp.dtype(dtype)),
-               int(level))
-        return self.cache.get(key, self._builder(bucket, k, dtype, level))
+        return self.cache.get(key, build), operands
 
     def _execute(self, batch, bucket: int, level: int) -> None:
         rows = sum(r.rows for r in batch)
         qpad = pad_rows(np.concatenate([r.queries for r in batch], axis=0)
                         if len(batch) > 1 else batch[0].queries, bucket)
-        try:
-            compiled, operands = self._compiled(bucket, batch[0].k,
-                                                qpad.dtype, level)
-            with tracing.range("serve.dispatch(%s,b=%d,k=%d,lvl=%d)",
-                               self.family, bucket, batch[0].k, level):
-                # explicit transfers at the serving boundary: device_put /
-                # device_get pass ``jax.transfer_guard("disallow")``, so a
-                # TraceGuard-wrapped serve loop proves these are the ONLY
-                # host<->device crossings on the path
-                d, i = compiled(jax.device_put(qpad), *operands)
-                d, i = jax.device_get((d, i))  # host fetch = completion barrier
-                d = np.asarray(d)
-                i = np.asarray(i)
-        except Exception as exc:  # noqa: BLE001 — fail the batch, not the server
-            for req in batch:
-                req.reject(ServeError(f"dispatch failed: {exc!r}"))
-            raise
+        retry = self.config.retry
+        attempt = 0
+        while True:
+            try:
+                self.faults.fire("execute")
+                compiled, operands = self._compiled(bucket, batch[0].k,
+                                                    qpad.dtype, level)
+                with tracing.range("serve.dispatch(%s,b=%d,k=%d,lvl=%d)",
+                                   self.family, bucket, batch[0].k, level):
+                    # explicit transfers at the serving boundary: device_put /
+                    # device_get pass ``jax.transfer_guard("disallow")``, so a
+                    # TraceGuard-wrapped serve loop proves these are the ONLY
+                    # host<->device crossings on the path
+                    d, i = compiled(jax.device_put(qpad), *operands)
+                    d, i = jax.device_get((d, i))  # host fetch = completion barrier
+                    d = np.asarray(d)
+                    i = np.asarray(i)
+                break
+            except TRANSIENT_FAULTS as exc:
+                attempt += 1
+                backoff = retry.backoff_s(attempt - 1)
+                earliest = min(r.deadline for r in batch)
+                if attempt > retry.max_retries:
+                    self.metrics.count("faulted_batches")
+                    for req in batch:
+                        req.reject(exc)
+                    return
+                if self.clock() + backoff > earliest:
+                    # deadline-aware retry budget: don't burn backoff on
+                    # answers nobody will be waiting for
+                    self.metrics.count("faulted_batches")
+                    err = DeadlineExceeded(
+                        f"transient fault ({exc!r}) and the next "
+                        f"{1e3 * backoff:.1f}ms backoff outlives the batch "
+                        "deadline")
+                    for req in batch:
+                        req.reject(err)
+                    return
+                self.metrics.count("retries")
+                self._sleep(backoff)
+            except Exception as exc:  # noqa: BLE001 — fail the batch, not the server
+                for req in batch:
+                    req.reject(ServeError(f"dispatch failed: {exc!r}"))
+                raise
         done = self.clock()
         self.metrics.observe_batch(bucket, rows, level)
         lo = 0
@@ -296,6 +382,80 @@ class SearchServer:
             self.metrics.observe_latency(1e3 * (done - req.t_submit),
                                          late=done > req.deadline)
             lo = hi
+
+    # -- generation handoff -------------------------------------------------
+
+    def swap_index(self, new_index=None, *, build=None):
+        """Install a new index generation with zero dropped requests.
+
+        Pass either a built ``new_index`` or a zero-arg ``build``
+        callable (run here, with transient-fault retry — the
+        OOM-on-extend recovery path).  The new generation is validated
+        (family / dim / query dtype / size ≥ k) and its level-0 ladder
+        pre-warmed **before** the atomic registry swap, so traffic never
+        waits on a compile; a same-shaped generation reuses every cached
+        executable (zero recompiles).  Any failure raises
+        :class:`.faults.SwapFailed` and leaves the old generation
+        serving.  In-flight batches that captured old-generation operands
+        complete against them — the swap never interrupts a dispatch."""
+        expects((new_index is None) != (build is None),
+                "pass exactly one of new_index= or build=")
+        old = self._registry.current
+        retry = self.config.retry
+        try:
+            if build is not None:
+                attempt = 0
+                while True:
+                    try:
+                        self.faults.fire("extend")
+                        new_index = build()
+                        break
+                    except TRANSIENT_FAULTS:
+                        attempt += 1
+                        if attempt > retry.max_retries:
+                            raise
+                        self.metrics.count("retries")
+                        self._sleep(retry.backoff_s(attempt - 1))
+            self.faults.fire("swap")
+            expects(family_of(new_index) == self.family,
+                    f"swap changes index family ({self.family} -> "
+                    f"{family_of(new_index)})")
+            expects(index_dim(new_index) == self._dim,
+                    f"swap changes vector dim ({self._dim} -> "
+                    f"{index_dim(new_index)})")
+            expects(str(jnp.dtype(query_dtype_of(new_index)))
+                    == str(jnp.dtype(self._qdtype)),
+                    "swap changes the query dtype")
+            expects(self.k <= index_size(new_index),
+                    f"new generation has {index_size(new_index)} rows < "
+                    f"k={self.k}")
+            # pre-warm the prospective generation OUTSIDE the registry —
+            # its compiles (zero, when shapes match) happen while the old
+            # generation keeps serving
+            prospective = type(old)(new_index, old.gen_id + 1)
+            for level in range(self.config.warm_levels):
+                for bucket in self.ladder:
+                    self._compiled(bucket, self.k, self._qdtype, level,
+                                   gen=prospective)
+        except Exception as exc:
+            self.metrics.count("failed_swaps")
+            raise SwapFailed(
+                f"swap aborted, generation {old.gen_id} still serving: "
+                f"{exc}") from exc
+        gen = self._registry.swap(new_index)
+        with self._parts_lock:
+            # re-key the pre-warmed parts under the REAL gen_id (a racing
+            # swap may have bumped it past the prospective one)
+            for (g, k, lvl) in list(self._searchers):
+                if g == prospective.gen_id and g != gen.gen_id:
+                    self._searchers[(gen.gen_id, k, lvl)] = \
+                        self._searchers.pop((g, k, lvl))
+        self.metrics.count("swaps")
+        if self._log is not None:
+            self._log.info("serve swap: generation %d -> %d (%s, %d rows)",
+                           old.gen_id, gen.gen_id, self.family,
+                           index_size(new_index))
+        return gen
 
     def _worker(self) -> None:
         max_rows = self.ladder[-1]
@@ -335,7 +495,8 @@ class SearchServer:
             "cache": self.cache.snapshot(),
             "server": {"family": self.family, "k": self.k,
                        "ladder": list(self.ladder),
-                       "index_rows": index_size(self.index)},
+                       "index_rows": index_size(self.index),
+                       "generation": self._registry.gen_id},
         })
         return snap
 

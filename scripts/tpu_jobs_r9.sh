@@ -1,0 +1,116 @@
+#!/bin/bash
+# Round-9 TPU job queue.  The r8 ladder plus the round-9 additions:
+#   * mutation_tp — bench/mutation_throughput.py measures the online
+#     extend() path against rebuild-from-scratch for both IVF families
+#     (plus tombstone-delete and compact timings) and writes
+#     bench/MUTATION_<BACKEND>.json — the on-hardware counterpart of
+#     the committed CPU artifact.
+#   * serve_swap — bench/serve.py in swap-under-load mode: generation
+#     handoffs while the measured client load runs; the final JSON's
+#     "swap" dict must report dropped == 0 and recompiles == 0 (the
+#     zero-downtime contract, tests/test_serve_lifecycle.py).
+#   * chaos_smoke — the same driver with RAFT_SERVE_FAULTS armed
+#     (wedged dispatches + one failed swap): proves the retry/backoff
+#     and swap-rollback paths on real hardware, not just under the
+#     deterministic fault tests.  Staged right after jaxlint — it is
+#     cheap and failure here means serving robustness regressed, which
+#     should gate the expensive benches.
+# Stage order: jaxlint -> chaos smoke -> Mosaic check -> build-throughput
+# -> mutation throughput -> probe/chunk tuners -> bench.py -> select_k
+# tuner -> prims -> cagra tuner -> cagra quality -> serve swap -> int8
+# -> profile.
+# Markers stay in /tmp/tpu_jobs_r3 so steps completed by earlier rounds'
+# queues are not repeated and tpu_ab_r4.sh's wait-chain keeps working.
+set -u
+cd /root/repo || exit 1
+LOG=/tmp/tpu_jobs_r3
+mkdir -p "$LOG"
+. "$(dirname "$0")/tpu_queue_lib.sh"
+acquire_queue_lock tpu_jobs_r9
+
+export RAFT_BENCH_CKPT_DIR="$LOG/bench_ckpt"
+
+# un-latch a bench.done that lacks a headline measurement (r3/r4 queues
+# gated on any measured line; a wedged-headline run must be retried)
+if [ -f "$LOG/bench.done" ] && \
+    ! bench_measured "$LOG/bench.log" brute_force 2>/dev/null; then
+  echo "$(date) removing stale bench.done (no headline measurement)" \
+    >> "$LOG/driver.log"
+  rm -f "$LOG/bench.done"
+fi
+
+# r9 refreshed the jaxlint census (extend-path waivers moved into the
+# rewritten extend(); the _max_source_id waiver was removed outright):
+# a pre-r9 jaxlint.done would leave the stale census committed
+if [ -f "$LOG/jaxlint.done" ] && \
+    grep -q "_max_source_id" bench/JAXLINT.json 2>/dev/null; then
+  echo "$(date) removing pre-r9 jaxlint.done (stale waiver census)" \
+    >> "$LOG/driver.log"
+  rm -f "$LOG/jaxlint.done"
+fi
+
+echo "$(date) [r9 queue] waiting for TPU..." >> "$LOG/driver.log"
+wait_probe
+echo "$(date) TPU is back" >> "$LOG/driver.log"
+
+run_step() {  # name, timeout_s, command...   (two attempts, gated .done)
+  local name=$1 tmo=$2; shift 2
+  [ -f "$LOG/$name.done" ] && return 0
+  local attempt
+  for attempt in 1 2; do
+    echo "$(date) start $name (attempt $attempt)" >> "$LOG/driver.log"
+    timeout "$tmo" "$@" > "$LOG/$name.$attempt.log" 2>&1 9<&-
+    rc=$?
+    cp -f "$LOG/$name.$attempt.log" "$LOG/$name.log"  # latest = canonical
+    if [ "$rc" -eq 0 ]; then
+      if [ "$name" != bench ] || bench_measured "$LOG/$name.log" brute_force; then
+        touch "$LOG/$name.done"
+        echo "$(date) done $name" >> "$LOG/driver.log"
+        return 0
+      fi
+      echo "$(date) $name exited 0 with no headline measurement (wedged backend)" \
+        >> "$LOG/driver.log"
+    else
+      echo "$(date) FAILED $name (rc=$rc)" >> "$LOG/driver.log"
+    fi
+    # a killed/wedged client can poison the tunnel for the next step too:
+    # re-probe before the retry (or before handing on to the next step)
+    wait_probe
+  done
+}
+
+# jaxlint first: pure-host AST pass, ~seconds, zero chip time — a hazard
+# (hidden sync, retrace loop, f64 leak) must never cost TPU minutes to find
+run_step jaxlint        300 python scripts/mini_lint.py --jax raft_tpu --stats-json bench/JAXLINT.json
+# chaos smoke: small index, short sweep, faults armed — two wedged
+# dispatches (recovered by retry) and one failed swap (rolled back).
+# Success = clean exit with a final JSON line; the armed faults are
+# consumed against the REAL backend dispatch path.
+run_step chaos_smoke    900 env RAFT_SERVE_FAULTS="execute:wedge:2,swap:fail" \
+    RAFT_BENCH_SERVE_ROWS=20000 RAFT_BENCH_SERVE_SECONDS=2 \
+    RAFT_BENCH_SERVE_CLIENTS=2,4 RAFT_BENCH_SERVE_SWAPS=2 \
+    python bench/serve.py
+run_step mosaic         900 env RAFT_MOSAIC_REQUIRE_TPU=1 python scripts/mosaic_check.py
+run_step build_tp      2400 python bench/build_throughput.py
+run_step mutation_tp   2400 python bench/mutation_throughput.py
+# tuners before the big benches: all three have /tmp resume checkpoints
+# (kernel-sha scoped), so a wedge mid-grid resumes on attempt 2
+run_step probe_tuner   3000 python bench/tune_probe_block.py
+run_step chunk_tuner   3000 python bench/tune_chunk_rows.py
+run_step bench         4500 python bench.py
+# the checkpoints exist to survive a wedge WITHIN a bench run; once the
+# headline-gated .done latches they are spent — leaving them would turn a
+# deliberately forced re-measurement (rm bench.done) into a silent replay
+[ -f "$LOG/bench.done" ] && rm -rf "$RAFT_BENCH_CKPT_DIR"
+run_step tuner         3000 python bench/tune_select_k.py
+run_step prims         3000 python bench/prims.py
+# cagra tuner immediately before the quality sweep: the sweep's auto
+# (itopk=0/width=0) points must consult the table this run just measured
+run_step cagra_tuner   3000 python bench/tune_cagra.py
+run_step cagra_quality 3000 python bench/cagra_quality.py
+# swap-under-load at bench scale, no faults: the recorded handoff numbers
+# (drops, p95 during swap, recompiles) for the round artifact
+run_step serve_swap    2400 env RAFT_BENCH_SERVE_SWAPS=3 python bench/serve.py
+run_step int8          1500 python scripts/tpu_validate_int8.py
+run_step profile       3000 python bench/profile_knn.py
+echo "$(date) all steps attempted" >> "$LOG/driver.log"

@@ -278,3 +278,40 @@ def test_neighbors_serving_adapter():
     np.testing.assert_array_equal(np.asarray(i0), i)
     np.testing.assert_array_equal(np.asarray(d0), d)
     assert snap["completed"] == 1 and snap["cache"]["compiles"] == 2
+
+
+def test_neighbors_extend_parity_with_native_online_insert():
+    """compat ``extend`` rides the native online-insert path: growing a
+    LIVE index through the adapter matches the native ``extend`` (and a
+    from-scratch rebuild of the union) bit-for-bit, for both families."""
+    from raft_tpu.compat.pylibraft.neighbors import ivf_flat as c_flat
+    from raft_tpu.compat.pylibraft.neighbors import ivf_pq as c_pq
+    from raft_tpu.neighbors import ivf_flat as n_flat
+    from raft_tpu.neighbors import ivf_pq as n_pq
+
+    rng = np.random.default_rng(9)
+    x = rng.standard_normal((260, 16)).astype(np.float32)
+    more = rng.standard_normal((40, 16)).astype(np.float32)
+
+    built = c_flat.build(c_flat.IndexParams(n_lists=8), x)
+    via_compat = c_flat.extend(built, more, np.arange(260, 300))
+    via_native = n_flat.extend(built, more, np.arange(260, 300))
+    sp_c, sp_n = c_flat.SearchParams(n_probes=8), \
+        n_flat.IvfFlatSearchParams(n_probes=8)
+    d0, i0 = c_flat.search(sp_c, via_compat, x[:7], 5)
+    d1, i1 = n_flat.search(via_native, x[:7], 5, sp_n)
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+    np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+    # auto-assigned ids continue from the current size, upstream-style
+    auto = c_flat.extend(built, more)
+    assert int(np.asarray(auto.counts).sum()) == 300
+    d2, i2 = c_flat.search(sp_c, auto, more[:4], 1)
+    assert (np.asarray(i2)[:, 0] >= 260).all()
+
+    pq = c_pq.build(c_pq.IndexParams(n_lists=8, pq_dim=8), x)
+    pq_c = c_pq.extend(pq, more, np.arange(260, 300))
+    pq_n = n_pq.extend(pq, more, np.arange(260, 300))
+    d3, i3 = c_pq.search(c_pq.SearchParams(n_probes=8), pq_c, x[:7], 5)
+    d4, i4 = n_pq.search(pq_n, x[:7], 5, n_pq.IvfPqSearchParams(n_probes=8))
+    np.testing.assert_array_equal(np.asarray(i3), np.asarray(i4))
+    np.testing.assert_array_equal(np.asarray(d3), np.asarray(d4))

@@ -34,3 +34,7 @@ def test_serving_guide_snippets_execute():
 
 def test_jax_hygiene_snippets_execute():
     _run_guide("jax_hygiene.md", min_blocks=6)
+
+
+def test_mutability_guide_snippets_execute():
+    _run_guide("mutability_guide.md", min_blocks=5)

@@ -1,0 +1,344 @@
+"""Mutable index lifecycle — online insert, tombstone delete, compaction.
+
+The contracts under test (ISSUE 6 acceptance criteria):
+
+* online ``extend()`` is **bit-identical** (values AND ids) to a
+  rebuild-from-scratch for both IVF families, including across multiple
+  incremental calls;
+* the insert path is zero-retrace / zero-implicit-transfer in steady
+  state under :class:`TraceGuard` (``transfer="disallow"``);
+* capacity exhaustion grows the slabs and never drops a row;
+* deleted ids never appear in results across all four families'
+  ``searcher()`` entry points, including sharded and extra-filtered
+  paths;
+* ``compact()`` drops tombstoned rows, preserves surviving results
+  exactly, and re-derives every IVF-PQ storage tier.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from raft_tpu.core import TraceGuard
+from raft_tpu.core.errors import RaftError
+from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq, mutation
+from raft_tpu.neighbors.mutation import (Tombstoned, compact, delete,
+                                         deleted_count)
+
+N, D, K = 400, 16, 5
+
+
+@pytest.fixture(scope="module")
+def db():
+    return np.random.default_rng(20).standard_normal((N, D)).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def queries():
+    return np.random.default_rng(21).standard_normal((12, D)).astype(np.float32)
+
+
+def _empty_like_flat(full):
+    """Same trained centroids, zero rows — the extend-vs-rebuild oracle."""
+    import jax.numpy as jnp
+
+    return ivf_flat.IvfFlatIndex(
+        full.centroids, jnp.zeros_like(full.data),
+        jnp.full_like(full.ids, -1), jnp.zeros_like(full.counts),
+        jnp.zeros_like(full.norms), full.metric)
+
+
+def _empty_like_pq(full):
+    import jax.numpy as jnp
+
+    return ivf_pq.IvfPqIndex(
+        full.centroids, full.codebooks, jnp.zeros_like(full.codes),
+        jnp.zeros_like(full.code_norms), jnp.full_like(full.ids, -1),
+        jnp.zeros_like(full.counts), full.metric)
+
+
+# ---------------------------------------------------------------------------
+# online extend — bit-identity vs rebuild
+
+
+def test_ivf_flat_extend_bit_identical_to_build(db, queries):
+    full = ivf_flat.build(db, ivf_flat.IvfFlatIndexParams(n_lists=8))
+    ext = ivf_flat.extend(_empty_like_flat(full), db)
+    sp = ivf_flat.IvfFlatSearchParams(n_probes=8)
+    d0, i0 = ivf_flat.search(full, queries, K, sp)
+    d1, i1 = ivf_flat.search(ext, queries, K, sp)
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+    np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+
+
+def test_ivf_flat_incremental_extends_match_one_shot(db, queries):
+    full = ivf_flat.build(db, ivf_flat.IvfFlatIndexParams(n_lists=8))
+    idx = _empty_like_flat(full)
+    for lo, hi in ((0, 150), (150, 280), (280, N)):
+        idx = ivf_flat.extend(idx, db[lo:hi], np.arange(lo, hi))
+    assert idx.size == N
+    sp = ivf_flat.IvfFlatSearchParams(n_probes=8)
+    d0, i0 = ivf_flat.search(full, queries, K, sp)
+    d1, i1 = ivf_flat.search(idx, queries, K, sp)
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+    np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+
+
+def test_ivf_pq_extend_bit_identical_to_build(db, queries):
+    full = ivf_pq.build(db, ivf_pq.IvfPqIndexParams(n_lists=8, pq_dim=8,
+                                                    pq_bits=4))
+    # match the build's tier config (store_recon default) so mode="auto"
+    # picks the same engine on both sides of the comparison
+    ext = ivf_pq.extend(_empty_like_pq(full), db).with_recon()
+    sp = ivf_pq.IvfPqSearchParams(n_probes=8)
+    d0, i0 = ivf_pq.search(full, queries, K, sp)
+    d1, i1 = ivf_pq.search(ext, queries, K, sp)
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+    np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+
+
+def test_ivf_pq_extend_rederives_storage_tiers(db, queries):
+    """extend on an index with recon + ADC tiers must return the same
+    tiers, matching a from-scratch derivation bit-for-bit."""
+    full = ivf_pq.build(db, ivf_pq.IvfPqIndexParams(n_lists=8, pq_dim=8,
+                                                    pq_bits=4,
+                                                    store_recon=True))
+    assert full.recon is not None and full.adc_norms is not None
+    ext = ivf_pq.extend(_empty_like_pq(full).with_adc_luts().with_recon(), db)
+    assert ext.recon is not None and ext.adc_norms is not None
+    for mode in ("recon", "lut"):
+        sp = ivf_pq.IvfPqSearchParams(n_probes=8, mode=mode)
+        d0, i0 = ivf_pq.search(full, queries, K, sp)
+        d1, i1 = ivf_pq.search(ext, queries, K, sp)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+
+
+def test_extend_growth_path_never_drops_rows(db, queries):
+    """Inserting 8x the built size exhausts list capacity: the slow path
+    must grow the slabs and place every row (n_probes = n_lists makes the
+    search exhaustive, so results match a fresh build exactly)."""
+    small = ivf_flat.build(db[:50], ivf_flat.IvfFlatIndexParams(n_lists=8))
+    grown = ivf_flat.extend(small, db[50:], np.arange(50, N))
+    assert grown.size == N
+    assert grown.list_cap > small.list_cap
+    sp = ivf_flat.IvfFlatSearchParams(n_probes=8)
+    d0, i0 = ivf_flat.search(ivf_flat.build(db, ivf_flat.IvfFlatIndexParams(
+        n_lists=8)), queries, K, sp)
+    d1, i1 = ivf_flat.search(grown, queries, K, sp)
+    np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+
+
+def test_ivf_pq_extend_growth_path(db):
+    small = ivf_pq.build(db[:64], ivf_pq.IvfPqIndexParams(n_lists=8,
+                                                          pq_dim=8,
+                                                          pq_bits=4))
+    grown = ivf_pq.extend(small, db[64:], np.arange(64, N))
+    assert grown.size == N
+    assert grown.list_cap > small.list_cap
+
+
+def test_extend_validation(db):
+    idx = ivf_flat.build(db, ivf_flat.IvfFlatIndexParams(n_lists=8))
+    with pytest.raises(RaftError):
+        ivf_flat.extend(idx, db[:3, :-1])  # dim mismatch
+    with pytest.raises(RaftError):
+        ivf_flat.extend(idx, db[:3], np.array([1, 2]))  # id count mismatch
+    with pytest.raises(RaftError):
+        ivf_flat.extend(idx, db[:2], np.array([-1, 4]))  # −1 is the pad
+
+
+def test_extend_steady_state_trace_guard(db):
+    """Acceptance gate: after one warm insert, further same-sized inserts
+    run with zero retraces, zero compiles, and zero implicit transfers
+    (the full ``transfer_guard("disallow")`` regime — the chunk staging
+    uses explicit device_put, the spill check explicit device_get)."""
+    rng = np.random.default_rng(22)
+    idx = ivf_flat.build(db, ivf_flat.IvfFlatIndexParams(n_lists=6))
+    nxt = N
+    idx = ivf_flat.extend(idx, rng.standard_normal((16, D)).astype(np.float32),
+                          np.arange(nxt, nxt + 16))
+    nxt += 16
+    jax.block_until_ready(idx.counts)
+    with TraceGuard() as tg:
+        for _ in range(4):
+            new = rng.standard_normal((16, D)).astype(np.float32)
+            idx = ivf_flat.extend(idx, new, np.arange(nxt, nxt + 16))
+            nxt += 16
+        jax.block_until_ready(idx.counts)
+    tg.assert_steady_state()
+    assert idx.size == N + 5 * 16
+
+
+# ---------------------------------------------------------------------------
+# tombstone deletes
+
+
+def _top1_ids(di):
+    return set(int(i) for i in np.asarray(di)[:, 0] if int(i) >= 0)
+
+
+def test_delete_composition_and_counts(db):
+    idx = ivf_flat.build(db, ivf_flat.IvfFlatIndexParams(n_lists=8))
+    t = delete(idx, [5, 9])
+    assert isinstance(t, Tombstoned) and deleted_count(t) == 2
+    t = delete(t, [9, 40])  # re-delete is a no-op, not an error
+    assert deleted_count(t) == 3
+    with pytest.raises(RaftError):
+        delete(idx, [-2])
+    with pytest.raises(RaftError):
+        delete(idx, [10 ** 9])  # outside the inferred id space
+    with pytest.raises(RaftError):
+        delete(t, [1], id_space=2)  # cannot shrink an existing mask
+    t2 = delete(idx, [1], id_space=4 * N)  # headroom for future inserts
+    assert t2.keep.n_bits == 4 * N
+
+
+@pytest.mark.parametrize("family", ["brute_force", "ivf_flat", "ivf_pq",
+                                    "cagra"])
+def test_deleted_ids_never_in_searcher_results(db, queries, family):
+    """The serving contract: tombstoned ids are unreachable through the
+    family's ``searcher()`` entry point (the path the serve runtime
+    compiles), and the holes are backfilled by live neighbors."""
+    if family == "brute_force":
+        index, params = db, None
+        fn0, ops0 = brute_force.searcher(db, K)
+    elif family == "ivf_flat":
+        index = ivf_flat.build(db, ivf_flat.IvfFlatIndexParams(n_lists=8))
+        params = ivf_flat.IvfFlatSearchParams(n_probes=8)
+        fn0, ops0 = ivf_flat.searcher(index, K, params)
+    elif family == "ivf_pq":
+        index = ivf_pq.build(db, ivf_pq.IvfPqIndexParams(n_lists=8, pq_dim=8,
+                                                         pq_bits=4))
+        params = ivf_pq.IvfPqSearchParams(n_probes=8)
+        fn0, ops0 = ivf_pq.searcher(index, K, params)
+    else:
+        index = cagra.build(db, cagra.CagraIndexParams(graph_degree=8))
+        params = cagra.CagraSearchParams(itopk_size=32)
+        fn0, ops0 = cagra.searcher(index, K, params)
+    _, di0 = fn0(queries, *ops0)
+    dead = _top1_ids(di0)
+    assert dead, "fixture should return real neighbors"
+    t = delete(index, np.array(sorted(dead), np.int32))
+
+    from raft_tpu.serve.searchers import make_searcher
+
+    fn, ops = make_searcher(t, K, params)
+    dv, di = fn(queries, *ops)
+    got = set(np.asarray(di).ravel().tolist())
+    assert not (got & dead), f"deleted ids {got & dead} leaked into results"
+    # every slot is a live id: deletions are backfilled, not blanked
+    # (k << live rows here; graph search may legitimately pad with −1)
+    if family != "cagra":
+        assert -1 not in got
+
+
+def test_delete_through_sharded_search(db, mesh8):
+    """Tombstone masks ride the sharded searchers' filter plumbing."""
+    x = np.random.default_rng(23).standard_normal((1600, D)).astype(np.float32)
+    q = x[:8]
+    fidx = ivf_flat.build_sharded(x, mesh8, ivf_flat.IvfFlatIndexParams(
+        n_lists=32, kmeans_n_iters=4))
+    t = delete(fidx, np.arange(8), id_space=1600)
+    _, ids = ivf_flat.search_sharded(
+        fidx, q, 3, ivf_flat.IvfFlatSearchParams(n_probes=4),
+        mesh=mesh8, filter=t.keep)
+    ids = np.asarray(ids)
+    assert not ((ids >= 0) & (ids < 8)).any()
+
+    cidx = cagra.build_sharded(x, mesh8, cagra.CagraIndexParams(
+        intermediate_graph_degree=16, graph_degree=8, n_routers=16))
+    tc = delete(cidx, np.arange(8), id_space=1600)
+    _, ids2 = cagra.search_sharded(
+        cidx, q, 3, cagra.CagraSearchParams(itopk_size=16),
+        mesh=mesh8, filter=tc.keep)
+    ids2 = np.asarray(ids2)
+    assert not ((ids2 >= 0) & (ids2 < 8)).any()
+
+
+def test_delete_composes_with_extra_filter(db, queries):
+    """mutation.search ANDs a caller filter into the tombstone mask."""
+    idx = ivf_flat.build(db, ivf_flat.IvfFlatIndexParams(n_lists=8))
+    sp = ivf_flat.IvfFlatSearchParams(n_probes=8)
+    _, di0 = ivf_flat.search(idx, queries, K, sp)
+    dead = _top1_ids(di0)
+    extra_banned = _top1_ids(np.asarray(di0)[:, 1:2])
+    t = delete(idx, np.array(sorted(dead), np.int32))
+    extra = np.ones(t.keep.n_bits, bool)
+    extra[sorted(extra_banned)] = False
+    _, di = mutation.search(t, queries, K, sp, filter=extra)
+    got = set(np.asarray(di).ravel().tolist())
+    assert not (got & (dead | extra_banned))
+
+
+def test_tombstoned_extend_preserves_mask(db, queries):
+    idx = ivf_flat.build(db, ivf_flat.IvfFlatIndexParams(n_lists=8))
+    sp = ivf_flat.IvfFlatSearchParams(n_probes=8)
+    _, di0 = ivf_flat.search(idx, queries, K, sp)
+    dead = _top1_ids(di0)
+    t = delete(idx, np.array(sorted(dead), np.int32), id_space=2 * N)
+    rng = np.random.default_rng(24)
+    t = mutation.extend(t, rng.standard_normal((32, D)).astype(np.float32),
+                        np.arange(N, N + 32))
+    assert isinstance(t, Tombstoned) and t.size == N + 32
+    assert t.keep.n_bits == 2 * N  # sized up front: no mask reshape
+    _, di = mutation.search(t, queries, K, sp)
+    assert not (set(np.asarray(di).ravel().tolist()) & dead)
+
+
+# ---------------------------------------------------------------------------
+# compaction
+
+
+def test_compact_preserves_surviving_results_ivf_flat(db, queries):
+    idx = ivf_flat.build(db, ivf_flat.IvfFlatIndexParams(n_lists=8))
+    sp = ivf_flat.IvfFlatSearchParams(n_probes=8)
+    _, di0 = ivf_flat.search(idx, queries, K, sp)
+    dead = _top1_ids(di0)
+    t = delete(idx, np.array(sorted(dead), np.int32))
+    d_t, i_t = mutation.search(t, queries, K, sp)
+    c = compact(t)
+    assert not isinstance(c, Tombstoned)  # tombstones consumed
+    assert c.size == N - len(dead)
+    d_c, i_c = ivf_flat.search(c, queries, K, sp)
+    np.testing.assert_array_equal(np.asarray(d_t), np.asarray(d_c))
+    np.testing.assert_array_equal(np.asarray(i_t), np.asarray(i_c))
+
+
+def test_compact_shrinks_cap_after_heavy_deletion(db):
+    idx = ivf_flat.build(db, ivf_flat.IvfFlatIndexParams(n_lists=8))
+    t = delete(idx, np.arange(0, N, 2))  # tombstone half the corpus
+    c = compact(t, headroom=1.5)
+    assert c.size == N // 2
+    assert c.list_cap < idx.list_cap
+
+
+def test_compact_ivf_pq_rederives_tiers(db, queries):
+    idx = ivf_pq.build(db, ivf_pq.IvfPqIndexParams(
+        n_lists=8, pq_dim=8, pq_bits=4, store_recon=True, pack_codes=True))
+    sp = ivf_pq.IvfPqSearchParams(n_probes=8)
+    _, di0 = ivf_pq.search(idx, queries, K, sp)
+    dead = _top1_ids(di0)
+    t = delete(idx, np.array(sorted(dead), np.int32))
+    d_t, i_t = mutation.search(t, queries, K, sp)
+    c = compact(t)
+    assert c.packed and c.recon is not None and c.adc_norms is not None
+    d_c, i_c = ivf_pq.search(c, queries, K, sp)
+    np.testing.assert_array_equal(np.asarray(d_t), np.asarray(d_c))
+    np.testing.assert_array_equal(np.asarray(i_t), np.asarray(i_c))
+
+
+def test_compact_refuses_positional_families(db):
+    cg = cagra.build(db, cagra.CagraIndexParams(graph_degree=8))
+    with pytest.raises(RaftError):
+        compact(delete(cg, [1]))
+    with pytest.raises(RaftError):
+        compact(delete(db, [1]))
+    with pytest.raises(RaftError):
+        compact(delete(ivf_flat.build(db, ivf_flat.IvfFlatIndexParams(
+            n_lists=8)), [1]), headroom=0.5)
