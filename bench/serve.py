@@ -28,6 +28,14 @@ Scale knobs (CPU smoke → TPU record):
                              (default 0 = off)
   RAFT_SERVE_FAULTS          arm the chaos injector (see serve.faults)
                              for a smoke of the retry/degrade paths
+  RAFT_BENCH_SERVE_RECOVERY  recovery-time mode (replaces the sweep):
+                             comma list of WAL record counts; for each,
+                             a DurableStore accumulates that many logged
+                             mutations past its last snapshot, then
+                             crash recovery (restore + replay + first
+                             answered query) is timed — the
+                             snapshot-cadence sizing curve.  Final JSON
+                             metric: serve_recovery_s (ivf_flat only)
 """
 
 from __future__ import annotations
@@ -63,6 +71,7 @@ BUDGET_MS = float(os.environ.get("RAFT_BENCH_SERVE_BUDGET_MS", 50))
 LADDER = tuple(int(b) for b in
                os.environ.get("RAFT_BENCH_SERVE_LADDER", "1,8,64").split(","))
 SWAPS = int(os.environ.get("RAFT_BENCH_SERVE_SWAPS", 0))
+RECOVERY = os.environ.get("RAFT_BENCH_SERVE_RECOVERY", "")
 
 # the mixed-shape request mix: point lookups dominate, small batches
 # common, bulk occasional — the traffic the bucket ladder is shaped for
@@ -185,6 +194,69 @@ def _swap_phase(srv, db, n_clients: int, n_swaps: int, seconds: float):
     }
 
 
+def run_recovery(spec: str = RECOVERY) -> dict:
+    """Crash-recovery timing: for each WAL length in ``spec`` (comma
+    list of record counts past the last snapshot), build a durable
+    ivf_flat deployment, accumulate that many logged mutations, and time
+    ``SearchServer.recover`` → first answered query.  The curve is the
+    snapshot-cadence sizing tool: restore cost is ~flat (snapshot load),
+    replay cost grows with the tail you allow between snapshots."""
+    import shutil
+    import tempfile
+
+    from raft_tpu.neighbors import ivf_flat, mutation
+    from raft_tpu.neighbors.wal import DurableStore
+    from raft_tpu.serve import SearchServer, ServerConfig
+
+    if FAMILY != "ivf_flat":
+        raise SystemExit("recovery mode mutates online: ivf_flat only")
+    tails = tuple(int(p) for p in spec.split(","))
+    rng = np.random.default_rng(0)
+    db = rng.standard_normal((ROWS, DIM)).astype(np.float32)
+    index, params = _build_index(db)
+    live = mutation.delete(index, [0], id_space=2 * ROWS)
+    queries = rng.standard_normal((8, DIM)).astype(np.float32)
+    points = []
+    for tail in tails:
+        root = tempfile.mkdtemp(prefix="raft-bench-recovery-")
+        try:
+            store = DurableStore.create(root, live)
+            for r in range(tail):  # the mutation workload past the snapshot
+                if r % 4 == 3:
+                    store.delete(rng.integers(0, ROWS, 2))
+                else:
+                    store.extend(
+                        rng.standard_normal((64, DIM)).astype(np.float32))
+            store.close()
+            wal_bytes = os.path.getsize(os.path.join(root, "wal.log"))
+            t0 = time.perf_counter()
+            srv = SearchServer.recover(root, k=K, params=params,
+                                       config=ServerConfig(ladder=LADDER))
+            restore_s = time.perf_counter() - t0
+            srv.search(queries)  # step()-driven: no thread needed
+            ready_s = time.perf_counter() - t0
+            point = {"config": "serve_recovery", "wal_records": tail,
+                     "wal_mib": round(wal_bytes / 2**20, 2),
+                     "restore_s": round(restore_s, 3),
+                     "ready_s": round(ready_s, 3),
+                     "replayed": srv.metrics.wal_replayed}
+            srv.durable_store.close()
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+        points.append(point)
+        print(json.dumps(point), flush=True)
+    final = {
+        "metric": "serve_recovery_s",
+        "value": points[-1]["ready_s"],
+        "unit": f"s@{tails[-1]}walrecords",
+        "family": FAMILY, "rows": ROWS, "dim": DIM, "k": K,
+        "backend": jax.default_backend(),
+        "points": points,
+    }
+    print(json.dumps(final), flush=True)
+    return final
+
+
 def run(seconds: float = SECONDS, clients=CLIENTS) -> dict:
     """Build index, start server, sweep concurrency; returns the final
     result dict (also printed as the last JSON line)."""
@@ -244,4 +316,7 @@ def run(seconds: float = SECONDS, clients=CLIENTS) -> dict:
 
 
 if __name__ == "__main__":
-    run()
+    if RECOVERY:
+        run_recovery()
+    else:
+        run()

@@ -7,13 +7,21 @@ index persistence.  Here the on-disk format is the same ``.npy`` stream, so
 artifacts interoperate with NumPy directly; scalars get the same header-framed
 encoding (``serialize_scalar``).  Index objects serialize as a directory of
 ``.npy`` files plus a JSON metadata header (orbax-style layout, but zero-dep).
+
+Durability tier (ISSUE 7): every array carries a CRC32 in ``meta.json``
+(``checksums``), writers can stage into a temp directory and publish with
+one atomic rename (``atomic=True``) after fsyncing every file, and
+:func:`verify_arrays` detects truncation and bit-flips without loading
+arrays into JAX — the building blocks for crash-consistent snapshots
+(``neighbors.serialize`` / ``neighbors.wal``).
 """
 
 from __future__ import annotations
 
 import json
 import os
-from typing import Any, BinaryIO, Dict, Union
+import zlib
+from typing import Any, BinaryIO, Dict, List, Optional, Union
 
 import jax
 import numpy as np
@@ -25,7 +33,15 @@ __all__ = [
     "deserialize_scalar",
     "save_arrays",
     "load_arrays",
+    "verify_arrays",
+    "CorruptArtifact",
+    "fsync_dir",
 ]
+
+
+class CorruptArtifact(ValueError):
+    """An on-disk artifact failed its integrity checks (truncated file,
+    checksum mismatch, unreadable metadata)."""
 
 
 def serialize_mdspan(stream: BinaryIO, array: Union[np.ndarray, jax.Array]) -> None:
@@ -49,35 +65,150 @@ def deserialize_scalar(stream: BinaryIO) -> Any:
     return arr[()]
 
 
-def save_arrays(path: Union[str, os.PathLike], arrays: Dict[str, Any], metadata: Dict[str, Any] = None) -> None:
+def npy_bytes(array) -> bytes:
+    """The exact ``.npy`` stream for ``array`` (header + data) — the unit
+    both the checksummed writers and the WAL frame records around."""
+    import io
+
+    buf = io.BytesIO()
+    serialize_mdspan(buf, array)
+    return buf.getvalue()
+
+
+def fsync_dir(path: Union[str, os.PathLike]) -> None:
+    """fsync a DIRECTORY so a just-renamed entry survives power loss (the
+    rename itself is atomic; its durability needs the parent synced)."""
+    fd = os.open(os.fspath(path), os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _write_file(path: str, data: bytes, fsync: bool) -> None:
+    with open(path, "wb") as f:
+        f.write(data)
+        if fsync:
+            f.flush()
+            os.fsync(f.fileno())
+
+
+def save_arrays(path: Union[str, os.PathLike], arrays: Dict[str, Any],
+                metadata: Dict[str, Any] = None, *, fsync: bool = False,
+                atomic: bool = False) -> None:
     """Persist a named bundle of arrays + JSON metadata under ``path``.
 
     Layout: ``path/meta.json`` + one ``path/<name>.npy`` per array.  This is
     the checkpoint/resume surface for index objects (the reference's
     downstream use of ``serialize_mdspan``).
+
+    ``meta.json`` carries a CRC32 per array (over the full ``.npy`` stream,
+    header included) so readers can detect truncation and bit-flips
+    (:func:`verify_arrays`).  ``fsync=True`` syncs every file (and the
+    directory) before returning; ``atomic=True`` stages the bundle in a
+    sibling temp directory and publishes it with one rename, so a crash
+    mid-write never leaves a half-written bundle at ``path`` (the
+    crash-consistent snapshot discipline — implies ``fsync``).
     """
     path = os.fspath(path)
+    if atomic:
+        fsync = True
+        final, path = path, f"{path}.tmp-{os.getpid()}"
+        if os.path.exists(path):
+            import shutil
+
+            shutil.rmtree(path)
     os.makedirs(path, exist_ok=True)
     names = sorted(arrays)
-    with open(os.path.join(path, "meta.json"), "w") as f:
-        json.dump({"arrays": names, "metadata": metadata or {}}, f, indent=1)
+    blobs = {name: npy_bytes(arrays[name]) for name in names}
+    meta = {
+        "arrays": names,
+        "metadata": metadata or {},
+        "checksums": {name: zlib.crc32(blob) for name, blob in blobs.items()},
+    }
     for name in names:
-        with open(os.path.join(path, f"{name}.npy"), "wb") as f:
-            serialize_mdspan(f, arrays[name])
+        _write_file(os.path.join(path, f"{name}.npy"), blobs[name], fsync)
+    # meta last: its presence marks a complete bundle even without atomic=
+    _write_file(os.path.join(path, "meta.json"),
+                json.dumps(meta, indent=1).encode(), fsync)
+    if fsync:
+        fsync_dir(path)
+    if atomic:
+        if os.path.exists(final):  # refresh-in-place: swap, drop the old
+            import shutil
+
+            trash = f"{final}.old-{os.getpid()}"
+            os.rename(final, trash)
+            os.rename(path, final)
+            shutil.rmtree(trash, ignore_errors=True)
+        else:
+            os.rename(path, final)
+        fsync_dir(os.path.dirname(os.path.abspath(final)) or ".")
 
 
-def load_arrays(path: Union[str, os.PathLike]):
+def load_arrays(path: Union[str, os.PathLike], *, verify: bool = False):
     """Inverse of :func:`save_arrays` → ``(arrays_dict, metadata_dict)``.
 
     Uses the native threaded reader from :mod:`raft_tpu.io` when the
-    extension is built, else ``np.load``.
+    extension is built, else ``np.load``.  ``verify=True`` checks every
+    array's CRC32 before returning (one extra read per file; artifacts
+    written before checksums existed pass unchecked).
     """
     from .. import io as rio
 
     path = os.fspath(path)
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
+    if verify:
+        problems = verify_arrays(path)
+        if problems:
+            raise CorruptArtifact(f"{path}: " + "; ".join(problems))
     arrays = {}
     for name in meta["arrays"]:
         arrays[name] = rio.read_npy(os.path.join(path, f"{name}.npy"))
     return arrays, meta.get("metadata", {})
+
+
+def verify_arrays(path: Union[str, os.PathLike]) -> List[str]:
+    """Integrity-check a :func:`save_arrays` bundle without loading it into
+    JAX.  Returns a list of problems (empty = intact): unreadable/absent
+    ``meta.json``, missing array files, CRC32 mismatches (bit-flips AND
+    truncation — the checksum covers the whole ``.npy`` stream).  Arrays
+    not covered by a checksum (pre-durability artifacts) are only checked
+    for existence."""
+    path = os.fspath(path)
+    problems: List[str] = []
+    try:
+        with open(os.path.join(path, "meta.json")) as f:
+            meta = json.load(f)
+    except (OSError, ValueError) as exc:
+        return [f"meta.json unreadable: {exc}"]
+    checksums = meta.get("checksums") or {}
+    for name in meta.get("arrays", ()):
+        fpath = os.path.join(path, f"{name}.npy")
+        try:
+            with open(fpath, "rb") as f:
+                blob = f.read()
+        except OSError as exc:
+            problems.append(f"{name}.npy unreadable: {exc}")
+            continue
+        want = checksums.get(name)
+        if want is not None and zlib.crc32(blob) != want:
+            problems.append(f"{name}.npy checksum mismatch "
+                            f"(bit-flip or truncation)")
+    return problems
+
+
+def checksum_file(path: Union[str, os.PathLike],
+                  chunk: int = 1 << 20) -> Optional[int]:
+    """CRC32 of a whole file (streamed), or None if unreadable."""
+    crc = 0
+    try:
+        with open(os.fspath(path), "rb") as f:
+            while True:
+                block = f.read(chunk)
+                if not block:
+                    return crc
+                crc = zlib.crc32(block, crc)
+    except OSError:
+        return None

@@ -22,13 +22,13 @@ __all__ = ["brute_force", "knn"]
 
 def __getattr__(name):
     if name in ("ivf_flat", "ivf_pq", "cagra", "refine", "serialize",
-                "mutation"):
+                "mutation", "wal"):
         import importlib
 
         mod = importlib.import_module(f"raft_tpu.neighbors.{name}")
         globals()[name] = mod
         return mod
-    if name in ("save_index", "load_index"):
+    if name in ("save_index", "load_index", "verify_index"):
         from . import serialize as _ser
 
         return getattr(_ser, name)

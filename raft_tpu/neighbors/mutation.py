@@ -62,9 +62,11 @@ class Tombstoned:
     @property
     def size(self) -> int:
         """Stored rows (tombstoned rows still occupy slots until
-        :func:`compact`)."""
-        return self.index.size if hasattr(self.index, "size") \
-            else self.index.shape[0]
+        :func:`compact`).  Brute databases are sized by rows — a raw
+        array's ``.size`` attribute counts elements, not rows."""
+        if getattr(self.index, "ndim", None) == 2:
+            return int(self.index.shape[0])
+        return int(self.index.size)
 
 
 def _default_id_space(index) -> int:
@@ -188,16 +190,33 @@ def compact(index, *, headroom: float = 2.0):
     have room).  Returns a PLAIN index — tombstones are consumed.  One
     device pass through the chunked builder's packer; derived IVF-PQ
     tiers (recon / ADC LUTs / 4-bit packing) are re-derived to match the
-    input.  Cagra/brute-force have no slab to rewrite — rebuild those."""
+    input.
+
+    A tombstoned **brute-force** database compacts too: dead rows drop
+    into a fresh contiguous slab (ROADMAP item 5's reclaim story).  Brute
+    ids are positional, so compaction renumbers survivors — new row ``i``
+    is old row ``kept[i]`` with ``kept`` the sorted live row numbers
+    (``headroom`` is meaningless, there are no lists).  Cagra has no slab
+    to rewrite — rebuild it."""
     from . import ivf_flat, ivf_pq
 
     base, keep = (index.index, index.keep) if isinstance(index, Tombstoned) \
         else (index, None)
     expects(headroom >= 1.0, "headroom must be >= 1.0")
+    if getattr(base, "ndim", None) == 2:  # brute-force database
+        if keep is None:
+            return jnp.asarray(base)
+        n = int(base.shape[0])
+        # the kept-row gather index is a static shape: one explicit host
+        # transfer per compaction, never on the search path
+        mask = np.asarray(host_rows(keep.to_bool_array()))[:n]
+        kept = np.flatnonzero(mask)
+        expects(kept.size >= 1, "compact would drop every row")
+        return jnp.asarray(base)[jnp.asarray(kept, jnp.int32)]
     is_pq = isinstance(base, ivf_pq.IvfPqIndex)
     expects(is_pq or isinstance(base, ivf_flat.IvfFlatIndex),
-            "compact is an IVF-family operation: cagra/brute-force store "
-            "rows positionally — rebuild instead")
+            "compact is an IVF-family operation (plus tombstoned brute-"
+            "force slabs): cagra stores rows positionally — rebuild it")
     was_packed = False
     if is_pq and base.packed:
         was_packed, base = True, base.with_unpacked_codes()

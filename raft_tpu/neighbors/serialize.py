@@ -8,30 +8,50 @@ Layout: one directory per index — a ``.npy`` file per array field plus a
 ``meta.json`` carrying the index type, static fields, and a format
 version (``core.serialize.save_arrays``).  Everything is plain NumPy on
 disk: artifacts are portable, inspectable, and loadable without JAX.
+
+Durability tier (ISSUE 7): per-array CRC32s ride ``meta.json``, writes
+stage into a temp directory + fsync + one atomic rename (a crash never
+leaves a half-written index where a reader looks), :func:`verify_index`
+detects truncation/bit-flips without constructing an index, and a
+``manifest`` (e.g. the WAL LSN watermark a snapshot is consistent with,
+``neighbors.wal``) travels inside the metadata.  ``mutation.Tombstoned``
+views and raw brute-force (n, d) databases serialize through the same
+entry points, so every serving family has a snapshot story.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Union
+from typing import List, Optional, Union
 
 import jax
 import numpy as np
 
-from ..core.serialize import load_arrays, save_arrays
+from ..core.serialize import load_arrays, save_arrays, verify_arrays
 
-__all__ = ["save_index", "load_index",
+__all__ = ["save_index", "load_index", "verify_index", "index_manifest",
            "save_index_checkpoint", "load_index_checkpoint"]
 
 # Readers accept <= _FORMAT_VERSION.  Writers stamp the LOWEST version
 # that can faithfully represent the artifact (_artifact_version), so only
-# genuinely new-format artifacts (4-bit packed codes, v2) are rejected by
-# older readers — everything else stays interchangeable.
-_FORMAT_VERSION = 2
+# genuinely new-format artifacts (4-bit packed codes, v2; tombstoned /
+# brute-force wrappers, v3) are rejected by older readers — everything
+# else stays interchangeable.
+_FORMAT_VERSION = 3
+
+#: index_type names handled structurally rather than via the dataclass
+#: registry: a raw (n, d) database and the tombstoned wrapper
+_BRUTE_TYPE = "BruteForce"
+_TOMBSTONED_TYPE = "Tombstoned"
+_KEEP_FIELD = "__keep_words"
 
 
 def _artifact_version(index) -> int:
+    from .mutation import Tombstoned
+
+    if isinstance(index, Tombstoned) or not hasattr(index, "metric"):
+        return 3
     return 2 if getattr(index, "packed", False) else 1
 
 
@@ -45,20 +65,41 @@ def _index_registry():
 
 
 def _validate_meta(meta, path):
-    """Shared metadata gate for both artifact tiers → the index class."""
+    """Shared metadata gate for both artifact tiers → the index class
+    (None for the structural types: brute-force / tombstoned)."""
     type_name = meta.get("index_type")
     registry = _index_registry()
-    if type_name not in registry:
+    if type_name not in registry and type_name != _BRUTE_TYPE \
+            and type_name != _TOMBSTONED_TYPE:
         raise ValueError(f"{path!r}: unknown or missing index_type {type_name!r}")
     if meta.get("format_version", 0) > _FORMAT_VERSION:
         raise ValueError(f"{path!r}: format_version {meta['format_version']} "
                          f"is newer than supported {_FORMAT_VERSION}")
-    return registry[type_name]
+    return registry.get(type_name)
 
 
-def save_index(path: Union[str, os.PathLike], index) -> None:
-    """Persist any of the ANN index dataclasses (IVF-Flat, IVF-PQ, CAGRA,
-    sharded CAGRA) to a directory of ``.npy`` files + JSON metadata."""
+def _index_meta(index, manifest=None):
+    """The meta dict for any serializable index shape; returns
+    ``(arrays, meta)`` with arrays as numpy."""
+    from .mutation import Tombstoned
+
+    if isinstance(index, Tombstoned):
+        arrays, meta = _index_meta(index.index, manifest)
+        assert _KEEP_FIELD not in arrays
+        arrays[_KEEP_FIELD] = np.asarray(index.keep.words)
+        meta = dict(meta, index_type=_TOMBSTONED_TYPE,
+                    format_version=3,
+                    tombstone={"wrapped_type": meta["index_type"],
+                               "n_bits": int(index.keep.n_bits)})
+        return arrays, meta
+    if isinstance(index, (jax.Array, np.ndarray)):
+        if np.ndim(index) != 2:
+            raise TypeError("a brute-force database must be a 2-D array")
+        return {"data": np.asarray(index)}, {
+            "index_type": _BRUTE_TYPE, "format_version": 3,
+            "static": {}, "derived_present": [],
+            "manifest": dict(manifest or {}),
+        }
     cls = type(index)
     if cls.__name__ not in _index_registry():
         raise TypeError(f"not a serializable index type: {cls.__name__}")
@@ -67,21 +108,59 @@ def save_index(path: Union[str, os.PathLike], index) -> None:
     # artifact and defeat PQ compression on disk
     arrays, static, derived = _split_fields(index)
     arrays = {k: np.asarray(v) for k, v in arrays.items()}
-    save_arrays(path, arrays, metadata={
+    return arrays, {
         "index_type": cls.__name__,
         "format_version": _artifact_version(index),
         "static": static,
         "derived_present": [f for f in derived
                             if getattr(index, f, None) is not None],
-    })
+        "manifest": dict(manifest or {}),
+    }
 
 
-def load_index(path: Union[str, os.PathLike], *, device: bool = True):
+def save_index(path: Union[str, os.PathLike], index, *,
+               manifest: Optional[dict] = None, atomic: bool = True,
+               fsync: bool = True) -> None:
+    """Persist any serving index — the ANN index dataclasses (IVF-Flat,
+    IVF-PQ, CAGRA, sharded CAGRA), a raw (n, d) brute-force database, or
+    a ``mutation.Tombstoned`` view of any of them — to a directory of
+    ``.npy`` files + JSON metadata.
+
+    Crash-consistent by default: every array carries a CRC32, files are
+    fsynced, and the bundle is staged in a temp directory and published
+    by one atomic rename — a reader (or :func:`verify_index`) never sees
+    a torn artifact.  ``manifest`` attaches caller metadata (the WAL LSN
+    watermark for ``neighbors.wal`` snapshots)."""
+    arrays, meta = _index_meta(index, manifest)
+    save_arrays(path, arrays, metadata=meta, atomic=atomic, fsync=fsync)
+
+
+def load_index(path: Union[str, os.PathLike], *, device: bool = True,
+               verify: bool = False):
     """Load an index saved by :func:`save_index`.  ``device=True`` places
     array fields on the default device; ``device=False`` keeps NumPy
-    (useful to inspect or re-shard before transfer)."""
-    arrays, meta = load_arrays(path)
+    (useful to inspect or re-shard before transfer).  ``verify=True``
+    checks every array's CRC32 first (``core.serialize.CorruptArtifact``
+    on mismatch — the recovery path quarantines instead of parsing)."""
+    arrays, meta = load_arrays(path, verify=verify)
+    return _index_from_parts(arrays, meta, path, device)
+
+
+def _index_from_parts(arrays, meta, path, device: bool):
     cls = _validate_meta(meta, path)
+    if meta.get("index_type") == _TOMBSTONED_TYPE:
+        from ..core.bitset import Bitset
+        from .mutation import Tombstoned
+
+        ts = meta.get("tombstone") or {}
+        words = arrays.pop(_KEEP_FIELD)
+        inner_meta = dict(meta, index_type=ts.get("wrapped_type"))
+        inner = _index_from_parts(arrays, inner_meta, path, device)
+        keep = Bitset(jnp_words(words, device), int(ts["n_bits"]))
+        return Tombstoned(inner, keep)
+    if meta.get("index_type") == _BRUTE_TYPE:
+        data = arrays["data"]
+        return jax.device_put(data) if device else data
     fields = dict(meta.get("static", {}))
     for name, arr in arrays.items():
         fields[name] = jax.device_put(arr) if device else arr
@@ -89,6 +168,44 @@ def load_index(path: Union[str, os.PathLike], *, device: bool = True):
     if device:
         index = _rebuild_derived(index, meta)
     return index
+
+
+def jnp_words(words, device: bool):
+    """Bitset words as the right array type for the load mode."""
+    return jax.device_put(words) if device else np.asarray(words)
+
+
+def index_manifest(path: Union[str, os.PathLike]) -> dict:
+    """The ``manifest`` dict a :func:`save_index` artifact was written
+    with (empty for pre-durability artifacts) — read from ``meta.json``
+    only, no array IO."""
+    import json
+
+    with open(os.path.join(os.fspath(path), "meta.json")) as f:
+        meta = json.load(f)
+    return dict((meta.get("metadata") or {}).get("manifest") or {})
+
+
+def verify_index(path: Union[str, os.PathLike]) -> List[str]:
+    """Integrity-check a :func:`save_index` artifact without constructing
+    an index: metadata well-formed, index type known, every array file
+    present with a matching CRC32 (detects truncation AND bit-flips).
+    Returns a list of problems — empty means the artifact is loadable.
+    Recovery (``neighbors.wal.DurableStore``) quarantines any snapshot
+    this flags instead of parsing it into a live index."""
+    import json
+
+    path = os.fspath(path)
+    problems = verify_arrays(path)
+    if any("meta.json" in p for p in problems):
+        return problems
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    try:
+        _validate_meta(meta.get("metadata") or {}, path)
+    except ValueError as exc:
+        problems.append(str(exc))
+    return problems
 
 
 def _rebuild_derived(index, meta):

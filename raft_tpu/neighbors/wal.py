@@ -1,0 +1,513 @@
+"""Write-ahead mutation log + durable index store (ISSUE 7 tentpole).
+
+Every ``extend``/``delete``/``compact`` on a :class:`DurableStore` is
+logged BEFORE the in-memory mutation applies — the log-then-apply
+discipline.  The mutations themselves (``neighbors.mutation``) are pure
+deterministic functions of (index state, operands), so replaying the log
+from a snapshot reproduces the live index bit-identically (values AND
+ids); crash recovery is "latest valid snapshot + WAL tail", exactly the
+classic database recipe, with the index pytree as the page store.
+
+On-disk format (``wal.log``):
+
+* file header: ``b"RTWL"`` + little-endian ``u32`` format version;
+* records: ``u64 lsn | u32 crc32(payload) | u64 payload_len | payload``;
+* payload: ``u32 jlen | json | <.npy stream per array>`` — json carries
+  ``{"op", "arrays": [names...], "static": {...}}`` and the array
+  streams follow in that order (``core.serialize.npy_bytes``).
+
+LSNs are monotonic from 1.  The CRC + length prefix make torn tails
+self-detecting: :func:`read_wal` stops at the first bad record and
+reports the last good byte offset, so recovery can quarantine the torn
+tail (copied aside, never parsed) and truncate.  Fsync policy is
+group-commit: ``WalConfig.group_window_s`` bounds the durability lag —
+0 (default) fsyncs every append, ``w > 0`` lets appends within ``w``
+seconds share one fsync (higher mutation throughput, up to ``w`` seconds
+of committed-to-page-cache records at risk on power loss; a clean
+process crash loses nothing either way).
+
+:class:`DurableStore` composes the log with crash-consistent snapshots
+(``neighbors.serialize.save_index``: per-array CRC32s, write-to-temp +
+fsync + atomic rename, manifest carrying the WAL LSN watermark) and
+:func:`DurableStore.recover`: newest valid snapshot wins, corrupted ones
+are quarantined and the previous good snapshot replays a longer tail.
+``serve/faults.py`` sites (``wal_append``/``extend``/``snapshot``/
+``rename``/``compact``) hook the exact crash windows the subprocess
+driver in ``tests/test_durability.py`` exercises.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+import os
+import shutil
+import struct
+import threading
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.errors import expects
+from ..core.serialize import (CorruptArtifact, deserialize_mdspan, fsync_dir,
+                              npy_bytes)
+from .serialize import index_manifest, load_index, save_index, verify_index
+
+__all__ = ["WalConfig", "WalRecord", "WriteAheadLog", "read_wal",
+           "replay", "DurableStore"]
+
+_MAGIC = b"RTWL"
+_WAL_VERSION = 1
+_FILE_HEADER = _MAGIC + struct.pack("<I", _WAL_VERSION)
+_REC_HEADER = struct.Struct("<QIQ")  # lsn, crc32(payload), payload_len
+_SNAP_PREFIX = "snap-"
+
+#: mutation ops a record may carry (anything else fails replay loudly)
+_OPS = ("extend", "delete", "compact")
+
+
+@dataclasses.dataclass(frozen=True)
+class WalConfig:
+    """Durability knobs.
+
+    ``group_window_s``: group-commit window — 0 fsyncs every append
+    (no committed record is ever lost); ``w > 0`` amortizes fsyncs over
+    all appends inside ``w`` seconds (bounded durability lag under power
+    loss, nothing lost on a process crash).  ``retain_snapshots``: how
+    many published snapshots :meth:`DurableStore.snapshot` keeps (older
+    ones are pruned; ≥ 2 leaves a fallback when the newest is corrupt).
+    """
+
+    group_window_s: float = 0.0
+    retain_snapshots: int = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class WalRecord:
+    """One decoded mutation record."""
+
+    lsn: int
+    op: str
+    arrays: Dict[str, np.ndarray]
+    static: Dict[str, Any]
+
+
+def _encode_payload(op: str, arrays: Dict[str, Any],
+                    static: Dict[str, Any]) -> bytes:
+    names = sorted(arrays)
+    j = json.dumps({"op": op, "arrays": names, "static": static},
+                   sort_keys=True).encode()
+    parts = [struct.pack("<I", len(j)), j]
+    parts += [npy_bytes(arrays[name]) for name in names]
+    return b"".join(parts)
+
+
+def _decode_payload(lsn: int, payload: bytes) -> WalRecord:
+    (jlen,) = struct.unpack_from("<I", payload)
+    head = json.loads(payload[4:4 + jlen].decode())
+    buf = io.BytesIO(payload[4 + jlen:])
+    arrays = {name: deserialize_mdspan(buf) for name in head["arrays"]}
+    return WalRecord(lsn, head["op"], arrays, head.get("static") or {})
+
+
+class WriteAheadLog:
+    """Append-only checksummed mutation log; thread-safe appends.
+
+    Opening an existing log scans it (validating every CRC) to resume
+    the LSN sequence; a torn/corrupt tail raises :class:`CorruptArtifact`
+    — :meth:`DurableStore.recover` quarantines + truncates first, so a
+    plain reopen never silently appends after garbage."""
+
+    def __init__(self, path: str, config: Optional[WalConfig] = None, *,
+                 clock=time.monotonic, _fsync=os.fsync) -> None:
+        self.path = os.fspath(path)
+        self.config = config or WalConfig()
+        self._clock = clock
+        self._fsync = _fsync
+        self._lock = threading.Lock()
+        self._last_sync = float("-inf")
+        self.syncs = 0
+        fresh = not os.path.exists(self.path) \
+            or os.path.getsize(self.path) == 0
+        if fresh:
+            self._lsn = 0
+            self._f = open(self.path, "ab")
+            self._f.write(_FILE_HEADER)
+            self._do_sync()
+        else:
+            records, good_end, problems = read_wal(self.path)
+            if problems:
+                raise CorruptArtifact(
+                    f"{self.path}: torn/corrupt tail ({'; '.join(problems)})"
+                    " — recover via DurableStore.recover, which quarantines"
+                    " and truncates it")
+            self._lsn = records[-1].lsn if records else 0
+            self._f = open(self.path, "ab")
+
+    @property
+    def lsn(self) -> int:
+        """LSN of the last appended record (0 = empty log)."""
+        return self._lsn
+
+    def append(self, op: str, arrays: Optional[Dict[str, Any]] = None,
+               static: Optional[Dict[str, Any]] = None) -> int:
+        """Write one record and return its LSN.  The record is on disk
+        (page cache) when this returns; it is *durable* per the group-
+        commit policy (``WalConfig.group_window_s``)."""
+        expects(op in _OPS, f"unknown WAL op {op!r} ({_OPS})")
+        payload = _encode_payload(op, arrays or {}, static or {})
+        with self._lock:
+            lsn = self._lsn + 1
+            self._f.write(_REC_HEADER.pack(lsn, zlib.crc32(payload),
+                                           len(payload)))
+            self._f.write(payload)
+            self._f.flush()
+            self._lsn = lsn
+            w = self.config.group_window_s
+            if w <= 0 or self._clock() - self._last_sync >= w:
+                self._do_sync()
+            return lsn
+
+    def _do_sync(self) -> None:
+        self._f.flush()
+        self._fsync(self._f.fileno())
+        self._last_sync = self._clock()
+        self.syncs += 1
+
+    def sync(self) -> None:
+        """Force-fsync pending records (snapshot watermarks call this so
+        the manifest never claims an LSN the disk doesn't hold)."""
+        with self._lock:
+            self._do_sync()
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._do_sync()
+                self._f.close()
+
+
+def read_wal(path) -> Tuple[List[WalRecord], int, List[str]]:
+    """Scan a WAL → ``(records, good_end, problems)``.
+
+    ``records`` are every intact record in order; ``good_end`` is the
+    byte offset just past the last intact record; ``problems`` is empty
+    for a clean log and otherwise describes the torn/corrupt tail (bad
+    magic, short header/payload, CRC mismatch, LSN discontinuity) —
+    everything past ``good_end`` is garbage to quarantine + truncate."""
+    path = os.fspath(path)
+    with open(path, "rb") as f:
+        blob = f.read()
+    if blob[:len(_FILE_HEADER)] != _FILE_HEADER:
+        return [], 0, [f"bad WAL header (want {_FILE_HEADER!r})"]
+    records: List[WalRecord] = []
+    off = len(_FILE_HEADER)
+    problems: List[str] = []
+    while off < len(blob):
+        if off + _REC_HEADER.size > len(blob):
+            problems.append(f"short record header at offset {off}")
+            break
+        lsn, crc, plen = _REC_HEADER.unpack_from(blob, off)
+        start = off + _REC_HEADER.size
+        payload = blob[start:start + plen]
+        if len(payload) < plen:
+            problems.append(f"short payload for lsn {lsn} at offset {off}")
+            break
+        if zlib.crc32(payload) != crc:
+            problems.append(f"crc mismatch for lsn {lsn} at offset {off}")
+            break
+        if lsn != (records[-1].lsn if records else 0) + 1:
+            problems.append(f"lsn discontinuity ({lsn}) at offset {off}")
+            break
+        try:
+            records.append(_decode_payload(lsn, payload))
+        except Exception as exc:  # undecodable but checksummed: corrupt
+            problems.append(f"undecodable payload for lsn {lsn}: {exc}")
+            break
+        off = start + plen
+    # off never advances past the last intact record (breaks happen
+    # before the advance), so it doubles as the truncation point
+    return records, off, problems
+
+
+def _apply(index, rec: WalRecord):
+    """Apply one WAL record — the ONLY mutation path a DurableStore uses,
+    live and during replay, so both are the same deterministic function."""
+    from . import mutation
+
+    if rec.op == "extend":
+        return mutation.extend(
+            index, rec.arrays["vectors"], rec.arrays.get("ids"),
+            insert_chunk=int(rec.static.get("insert_chunk", 0)))
+    if rec.op == "delete":
+        return mutation.delete(index, rec.arrays["ids"],
+                               id_space=int(rec.static.get("id_space", 0)))
+    if rec.op == "compact":
+        out = mutation.compact(index,
+                               headroom=float(rec.static.get("headroom",
+                                                             2.0)))
+        n = int(rec.static.get("rewrap_bits", 0))
+        if n:  # preserve the delete-headroom mask shape across compaction
+            from ..core.bitset import Bitset
+            from .mutation import Tombstoned
+
+            out = Tombstoned(out, Bitset.create(n, True))
+        return out
+    raise CorruptArtifact(f"unknown WAL op {rec.op!r}")
+
+
+def replay(index, records) -> Any:
+    """Fold WAL records over ``index`` in LSN order.  Deterministic: the
+    result is bit-identical to having applied the mutations live."""
+    for rec in records:
+        index = _apply(index, rec)
+    return index
+
+
+class DurableStore:
+    """A mutable index + its durability machinery, rooted at one
+    directory::
+
+        root/wal.log            append-only mutation log
+        root/snapshots/snap-<lsn>/   crash-consistent checkpoints
+        root/quarantine/        corrupted artifacts, renamed aside
+
+    Mutators are log-then-apply under one lock (the serve dispatch path
+    never enters here — it reads registry generations).  ``faults`` is an
+    optional ``serve.faults.FaultInjector`` whose ``wal_append`` /
+    ``extend`` / ``snapshot`` / ``rename`` / ``compact`` sites bracket
+    the crash windows; ``counters`` accumulates ``wal_appends`` /
+    ``wal_replayed`` / ``quarantined_files`` / ``recoveries`` /
+    ``snapshots`` and is mirrored into ``ServingMetrics`` when a server
+    adopts the store (``SearchServer.recover``)."""
+
+    def __init__(self, root, index=None, *,
+                 config: Optional[WalConfig] = None, faults=None,
+                 clock=time.monotonic, _fsync=os.fsync) -> None:
+        self.root = os.fspath(root)
+        self.snap_dir = os.path.join(self.root, "snapshots")
+        self.quarantine_dir = os.path.join(self.root, "quarantine")
+        for d in (self.root, self.snap_dir, self.quarantine_dir):
+            os.makedirs(d, exist_ok=True)
+        self.config = config or WalConfig()
+        self.faults = faults
+        self.index = index
+        self.counters: Dict[str, int] = {}
+        self.metrics = None  # ServingMetrics mirror once a server adopts us
+        self._lock = threading.RLock()
+        self.wal = WriteAheadLog(os.path.join(self.root, "wal.log"),
+                                 self.config, clock=clock, _fsync=_fsync)
+
+    # -- bookkeeping --------------------------------------------------
+
+    def _count(self, name: str, n: int = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + n
+        if self.metrics is not None:
+            self.metrics.count(name, n)
+
+    def _fire(self, site: str, path: Optional[str] = None) -> None:
+        if self.faults is not None:
+            self.faults.fire(site, path=path)
+
+    @property
+    def wal_lsn(self) -> int:
+        """Current WAL watermark (LSN of the last logged mutation)."""
+        return self.wal.lsn
+
+    # -- construction -------------------------------------------------
+
+    @classmethod
+    def create(cls, root, index, **kw) -> "DurableStore":
+        """Initialize a fresh store: adopt ``index`` and publish the
+        initial snapshot (the replay base — a WAL with no snapshot under
+        it would be unreplayable)."""
+        store = cls(root, index, **kw)
+        store.snapshot()
+        return store
+
+    # -- durable mutators (log-then-apply) ----------------------------
+
+    def extend(self, vectors, ids=None, *, insert_chunk: int = 0):
+        """Durable insert: logged (and fsynced per policy) before the
+        in-memory ``mutation.extend`` applies.  A crash after the append
+        recovers WITH the insert (replayed); before it, without — never a
+        half-applied state."""
+        arrays = {"vectors": np.asarray(vectors)}
+        static: Dict[str, Any] = {"insert_chunk": int(insert_chunk)}
+        if ids is not None:
+            arrays["ids"] = np.asarray(ids)
+        return self._durable("extend", arrays, static, crash_site="extend")
+
+    def delete(self, ids, *, id_space: int = 0):
+        """Durable tombstone: same log-then-apply contract as
+        :meth:`extend`."""
+        return self._durable(
+            "delete", {"ids": np.asarray(ids)},
+            {"id_space": int(id_space)}, crash_site="extend")
+
+    def compact(self, *, headroom: float = 2.0, rewrap: bool = True):
+        """Durable compaction.  ``rewrap=True`` (the serving default)
+        re-wraps the compacted index in a fresh all-live tombstone mask
+        of the SAME bit width, so the searcher's mask operand keeps one
+        shape across compactions (no recompile) and later deletes have
+        their headroom back.  Logged first: a crash mid-compaction
+        recovers to the old generation (append lost) or the new one
+        (record replayed) — never a hybrid."""
+        from .mutation import Tombstoned
+
+        n_bits = self.index.keep.n_bits \
+            if isinstance(self.index, Tombstoned) and rewrap else 0
+        return self._durable(
+            "compact", {},
+            {"headroom": float(headroom), "rewrap_bits": int(n_bits)},
+            crash_site="compact")
+
+    def _durable(self, op, arrays, static, *, crash_site: str):
+        with self._lock:
+            expects(self.index is not None, "store has no index (use "
+                    "DurableStore.create or DurableStore.recover)")
+            # corrupt-kind faults at this site byte-flip the existing log
+            # (torn-tail drill); crash-kind ones lose the op entirely
+            self._fire("wal_append", self.wal.path)
+            lsn = self.wal.append(op, arrays, static)
+            self._count("wal_appends")
+            # crash here = committed but unapplied: replay restores it
+            self._fire(crash_site)
+            self.index = _apply(self.index, WalRecord(lsn, op, arrays,
+                                                      static))
+            return self.index
+
+    # -- snapshots ----------------------------------------------------
+
+    def snapshot(self) -> str:
+        """Publish a crash-consistent checkpoint of the current index at
+        the current WAL watermark.  Staged fully (checksummed + fsynced)
+        in a temp directory, then one atomic rename — a crash at either
+        armed site (``snapshot``: staged-but-unpublished; ``rename``:
+        ditto) leaves the previous snapshot authoritative and recovery
+        replays a longer WAL tail.  Prunes to
+        ``WalConfig.retain_snapshots`` published snapshots."""
+        with self._lock:
+            expects(self.index is not None, "store has no index")
+            self.wal.sync()  # the manifest must never lead the disk
+            lsn = self.wal.lsn
+            final = os.path.join(self.snap_dir, f"{_SNAP_PREFIX}{lsn:020d}")
+            tmp = f"{final}.tmp-{os.getpid()}"
+            save_index(tmp, self.index, manifest={"wal_lsn": lsn},
+                       atomic=False, fsync=True)
+            self._fire("snapshot", tmp)
+            self._fire("rename", final)
+            if os.path.exists(final):  # re-snapshot at an unchanged lsn
+                trash = f"{final}.old-{os.getpid()}"
+                os.rename(final, trash)
+                os.rename(tmp, final)
+                shutil.rmtree(trash, ignore_errors=True)
+            else:
+                os.rename(tmp, final)
+            fsync_dir(self.snap_dir)
+            self._count("snapshots")
+            self._prune_snapshots()
+            return final
+
+    def _prune_snapshots(self) -> None:
+        keep = max(1, int(self.config.retain_snapshots))
+        snaps = sorted(n for n in os.listdir(self.snap_dir)
+                       if n.startswith(_SNAP_PREFIX) and "." not in n)
+        for name in snaps[:-keep]:
+            shutil.rmtree(os.path.join(self.snap_dir, name),
+                          ignore_errors=True)
+
+    def snapshots(self) -> List[str]:
+        """Published snapshot directory names, oldest → newest."""
+        return sorted(n for n in os.listdir(self.snap_dir)
+                      if n.startswith(_SNAP_PREFIX) and "." not in n)
+
+    # -- recovery -----------------------------------------------------
+
+    def _quarantine(self, path: str, reason: str) -> None:
+        base = os.path.basename(path)
+        dest = os.path.join(self.quarantine_dir, base)
+        i = 0
+        while os.path.exists(dest):
+            i += 1
+            dest = os.path.join(self.quarantine_dir, f"{base}.{i}")
+        os.rename(path, dest)
+        with open(dest + ".reason", "w") as f:
+            f.write(reason + "\n")
+        self._count("quarantined_files")
+
+    @classmethod
+    def recover(cls, root, *, config: Optional[WalConfig] = None,
+                faults=None, device: bool = True, clock=time.monotonic,
+                _fsync=os.fsync) -> "DurableStore":
+        """Restore a store after a crash: newest snapshot that passes
+        ``verify_index`` wins (corrupted/incomplete ones are quarantined,
+        never parsed), the WAL tail past its LSN watermark replays (a
+        torn/corrupt tail is quarantined + truncated first), and the
+        returned store is ready to mutate and snapshot again.  Raises
+        :class:`CorruptArtifact` when no valid snapshot survives."""
+        self = cls.__new__(cls)
+        self.root = os.fspath(root)
+        self.snap_dir = os.path.join(self.root, "snapshots")
+        self.quarantine_dir = os.path.join(self.root, "quarantine")
+        for d in (self.root, self.snap_dir, self.quarantine_dir):
+            os.makedirs(d, exist_ok=True)
+        self.config = config or WalConfig()
+        self.faults = faults
+        self.index = None
+        self.counters = {}
+        self.metrics = None
+        self._lock = threading.RLock()
+
+        # 1) snapshots: quarantine strays (crashed-mid-publish temp dirs),
+        #    then walk published ones newest-first until one verifies
+        watermark = None
+        for name in sorted(os.listdir(self.snap_dir)):
+            if not name.startswith(_SNAP_PREFIX) or "." in name:
+                self._quarantine(os.path.join(self.snap_dir, name),
+                                 "incomplete snapshot (crash mid-publish)")
+        for name in reversed(self.snapshots()):
+            path = os.path.join(self.snap_dir, name)
+            problems = verify_index(path)
+            if problems:
+                self._quarantine(path, "; ".join(problems))
+                continue
+            self.index = load_index(path, device=device)
+            watermark = int(index_manifest(path).get("wal_lsn", 0))
+            break
+        if self.index is None:
+            raise CorruptArtifact(
+                f"{self.root}: no valid snapshot to recover from "
+                f"(quarantined {self.counters.get('quarantined_files', 0)})")
+
+        # 2) WAL: quarantine + truncate a torn tail, then replay past the
+        #    snapshot watermark
+        wal_path = os.path.join(self.root, "wal.log")
+        if os.path.exists(wal_path) and os.path.getsize(wal_path) > 0:
+            records, good_end, problems = read_wal(wal_path)
+            if problems:
+                tail_name = f"wal-tail-{good_end}.bin"
+                dest = os.path.join(self.quarantine_dir, tail_name)
+                with open(wal_path, "rb") as src, open(dest, "wb") as out:
+                    src.seek(good_end)
+                    shutil.copyfileobj(src, out)
+                with open(dest + ".reason", "w") as f:
+                    f.write("; ".join(problems) + "\n")
+                self._count("quarantined_files")
+                with open(wal_path, "r+b") as f:
+                    f.truncate(good_end)
+                    f.flush()
+                    os.fsync(f.fileno())
+            tail = [r for r in records if r.lsn > watermark]
+            self.index = replay(self.index, tail)
+            self._count("wal_replayed", len(tail))
+        self.wal = WriteAheadLog(wal_path, self.config, clock=clock,
+                                 _fsync=_fsync)
+        self._count("recoveries")
+        return self
+
+    def close(self) -> None:
+        self.wal.close()

@@ -49,12 +49,13 @@ True
 >>> srv.stop()
 """
 
-from .admission import (AdmissionController, AdmissionPolicy,
+from .admission import (AdmissionController, AdmissionPolicy, Backoff,
                         DeadlineExceeded, QueueFull, RetryPolicy, ServeError)
 from .bucketing import DEFAULT_LADDER, bucket_for, normalize_ladder
 from .cache import ExecutableCache
-from .faults import (TRANSIENT_FAULTS, DeviceOOM, FaultError, FaultInjector,
-                     SwapFailed, WedgedDevice)
+from .compaction import CompactionPolicy, CompactionScheduler
+from .faults import (CRASH_EXIT_CODE, TRANSIENT_FAULTS, DeviceOOM, FaultError,
+                     FaultInjector, SwapFailed, WedgedDevice)
 from .metrics import ServingMetrics
 from .registry import Generation, IndexRegistry
 from .searchers import family_of, make_searcher, unwrap_tombstones
@@ -63,6 +64,10 @@ from .server import SearchServer, ServerConfig
 __all__ = [
     "SearchServer",
     "ServerConfig",
+    "CompactionPolicy",
+    "CompactionScheduler",
+    "Backoff",
+    "CRASH_EXIT_CODE",
     "ExecutableCache",
     "ServingMetrics",
     "AdmissionPolicy",

@@ -20,7 +20,8 @@ from typing import Tuple
 from ..core.errors import RaftError, expects
 
 __all__ = ["ServeError", "QueueFull", "DeadlineExceeded",
-           "AdmissionPolicy", "AdmissionController", "RetryPolicy"]
+           "AdmissionPolicy", "AdmissionController", "RetryPolicy",
+           "Backoff"]
 
 
 class ServeError(RaftError):
@@ -40,12 +41,21 @@ class RetryPolicy:
     """Backoff schedule for *transient* faults (``faults.TRANSIENT_FAULTS``:
     wedged device, device OOM).  Retries are deadline-aware — the server
     stops retrying a batch once the next backoff would outlive the
-    earliest deadline in it, rejecting instead of burning the budget."""
+    earliest deadline in it, rejecting instead of burning the budget.
+
+    ``jitter="decorrelated"`` (default) draws each sleep from
+    ``uniform(backoff_ms, 3 × previous)`` clamped to
+    ``[backoff_ms, max_backoff_ms]`` — the AWS decorrelated-jitter
+    schedule, so a fleet of replicas retrying one shared fault spreads
+    out instead of synchronizing into retry storms.  ``jitter="none"``
+    keeps the deterministic exponential (``backoff_s``), for tests that
+    pin exact sleeps.  ``max_backoff_ms`` is a HARD cap either way."""
 
     max_retries: int = 2
     backoff_ms: float = 5.0
     multiplier: float = 2.0
     max_backoff_ms: float = 100.0
+    jitter: str = "decorrelated"
 
     def __post_init__(self):
         expects(self.max_retries >= 0, "max_retries must be >= 0")
@@ -53,11 +63,52 @@ class RetryPolicy:
         expects(self.multiplier >= 1.0, "multiplier must be >= 1.0")
         expects(self.max_backoff_ms >= self.backoff_ms,
                 "max_backoff_ms must be >= backoff_ms")
+        expects(self.jitter in ("none", "decorrelated"),
+                f"jitter must be 'none' or 'decorrelated', "
+                f"got {self.jitter!r}")
 
     def backoff_s(self, attempt: int) -> float:
-        """Sleep before retry ``attempt`` (0-based), in seconds."""
+        """Jitter-free sleep before retry ``attempt`` (0-based), seconds —
+        the deterministic envelope :class:`Backoff` jitters inside."""
         ms = self.backoff_ms * (self.multiplier ** max(0, int(attempt)))
         return min(ms, self.max_backoff_ms) / 1e3
+
+    def start(self, rng=None) -> "Backoff":
+        """Fresh per-retry-sequence backoff state (one per faulted batch/
+        build).  ``rng``: a ``random.Random`` for deterministic tests."""
+        return Backoff(self, rng)
+
+
+class Backoff:
+    """Stateful backoff iterator for ONE retry sequence.
+
+    Every sleep lies in ``[backoff_ms, max_backoff_ms]`` (the jitter-
+    bounds contract ``tests/test_serve_lifecycle.py`` pins); under
+    decorrelated jitter consecutive sleeps may shrink — that is the
+    point, replicas desynchronize."""
+
+    def __init__(self, policy: RetryPolicy, rng=None) -> None:
+        import random
+
+        self.policy = policy
+        self._rng = rng if rng is not None else random.Random()
+        self._attempt = 0
+        self._prev_ms = policy.backoff_ms
+
+    def next_s(self) -> float:
+        """The next sleep, in seconds (caller enforces ``max_retries``
+        and the deadline-aware refusal)."""
+        p = self.policy
+        if p.jitter == "none":
+            ms = min(p.backoff_ms * (p.multiplier ** self._attempt),
+                     p.max_backoff_ms)
+        else:
+            hi = max(p.backoff_ms, self._prev_ms * 3.0)
+            ms = min(p.max_backoff_ms,
+                     self._rng.uniform(p.backoff_ms, hi))
+        self._attempt += 1
+        self._prev_ms = ms
+        return ms / 1e3
 
 
 @dataclasses.dataclass(frozen=True)

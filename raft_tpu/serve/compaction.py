@@ -1,0 +1,198 @@
+"""Background compaction scheduler (ROADMAP item 5, owned by ISSUE 7).
+
+Watches the serving generation's registry stats and reclaims storage
+when either trigger fires:
+
+* **dead fraction** — tombstoned ids / stored rows ≥
+  ``CompactionPolicy.dead_fraction`` (filtered search still pays for the
+  dead rows' distances; compaction drops them);
+* **overfull lists** — the fullest IVF list's occupancy ≥
+  ``CompactionPolicy.overfull_fraction`` of ``list_cap`` (the next
+  insert burst would hit the slab-growth slow path; compaction re-caps
+  with ``headroom`` ×).
+
+The actual work routes through ``SearchServer.swap_index(build=...)`` —
+the PR-6 handoff primitive: the compacted generation builds off-thread
+under the existing transient-fault :class:`~.admission.RetryPolicy`,
+gets validated + pre-warmed while the old generation keeps serving, and
+swaps in atomically (zero dropped requests).  With a
+``neighbors.wal.DurableStore`` attached, the build is the store's
+*durable* ``compact()`` — logged before it applies — so a crash
+mid-compaction recovers to the old generation (record lost) or the new
+one (record replayed), never a hybrid.
+
+Compacted indexes are re-wrapped in a fresh all-live tombstone mask of
+the SAME bit width by default: the searcher's keep-mask operand keeps
+one shape across compactions (no recompile) and later deletes have
+their headroom back.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.errors import expects
+
+__all__ = ["CompactionPolicy", "CompactionScheduler"]
+
+
+@dataclasses.dataclass(frozen=True)
+class CompactionPolicy:
+    """Trigger thresholds + pacing for :class:`CompactionScheduler`."""
+
+    dead_fraction: float = 0.3
+    overfull_fraction: float = 0.9
+    headroom: float = 2.0
+    min_interval_s: float = 0.0
+    poll_interval_s: float = 0.05
+    rewrap: bool = True
+
+    def __post_init__(self):
+        expects(0.0 < self.dead_fraction <= 1.0,
+                "dead_fraction must lie in (0, 1]")
+        expects(0.0 < self.overfull_fraction <= 1.0,
+                "overfull_fraction must lie in (0, 1]")
+        expects(self.headroom >= 1.0, "headroom must be >= 1.0")
+        expects(self.min_interval_s >= 0, "min_interval_s must be >= 0")
+        expects(self.poll_interval_s > 0, "poll_interval_s must be > 0")
+
+
+class CompactionScheduler:
+    """Polls one server's serving generation and compacts when due.
+
+    Deterministic-test surface: ``stats()`` / ``due()`` / ``run_once()``
+    need no thread (drive them inline with a fake clock);
+    ``start()``/``stop()`` run the same loop on a daemon thread for real
+    deployments.  ``store`` (optional ``neighbors.wal.DurableStore``)
+    makes compactions durable — the WAL checkpoint is what turns a crash
+    mid-compaction into a clean old-or-new recovery."""
+
+    def __init__(self, server, policy: Optional[CompactionPolicy] = None, *,
+                 store=None, clock=time.monotonic, sleep=time.sleep) -> None:
+        self.server = server
+        self.policy = policy or CompactionPolicy()
+        self.store = store
+        self.clock = clock
+        self._sleep = sleep
+        self._last_run = float("-inf")
+        self.last_error: Optional[BaseException] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- triggers -----------------------------------------------------
+
+    def stats(self) -> dict:
+        """Registry-sampled trigger inputs for the CURRENT generation:
+        ``rows``, ``dead`` (tombstoned ids), ``dead_fraction``, and
+        ``occupancy`` (fullest IVF list / cap; 0 for list-less
+        families).  Two explicit host scalars per poll, never on the
+        dispatch path."""
+        from ..neighbors import mutation
+
+        index = self.server.index
+        rows = float(index.shape[0]
+                     if getattr(index, "ndim", None) == 2
+                     else index.size)
+        dead = 0
+        if isinstance(index, mutation.Tombstoned):
+            dead = mutation.deleted_count(index)
+        base = index.index if isinstance(index, mutation.Tombstoned) \
+            else index
+        occupancy = 0.0
+        counts = getattr(base, "counts", None)
+        cap = getattr(base, "list_cap", 0)
+        if counts is not None and cap:
+            # scheduler poll scalar, off the search path
+            fullest = int(jax.device_get(jnp.max(counts)))  # jaxlint: disable=JX01 scheduler poll scalar, never on the dispatch path
+            occupancy = fullest / float(cap)
+        return {"rows": rows, "dead": dead,
+                "dead_fraction": dead / rows if rows else 0.0,
+                "occupancy": occupancy}
+
+    def due(self, now: Optional[float] = None) -> Optional[str]:
+        """The trigger that fires now ("dead_fraction" / "overfull"), or
+        None — also None inside the ``min_interval_s`` cooldown."""
+        now = self.clock() if now is None else now
+        if now - self._last_run < self.policy.min_interval_s:
+            return None
+        s = self.stats()
+        if s["dead_fraction"] >= self.policy.dead_fraction:
+            return "dead_fraction"
+        if s["occupancy"] >= self.policy.overfull_fraction:
+            return "overfull"
+        return None
+
+    # -- the work -----------------------------------------------------
+
+    def _build(self):
+        """The compacted next generation (the ``swap_index(build=)``
+        thunk — retried there under the server's RetryPolicy)."""
+        from ..core.bitset import Bitset
+        from ..neighbors import mutation
+
+        p = self.policy
+        if self.store is not None:
+            return self.store.compact(headroom=p.headroom, rewrap=p.rewrap)
+        index = self.server.index
+        out = mutation.compact(index, headroom=p.headroom)
+        if p.rewrap and isinstance(index, mutation.Tombstoned):
+            out = mutation.Tombstoned(
+                out, Bitset.create(index.keep.n_bits, True))
+        return out
+
+    def run_once(self, now: Optional[float] = None) -> Optional[str]:
+        """Check triggers and, when due, compact + swap.  Returns the
+        trigger that ran, or None.  Failures count
+        ``compactions_failed``, park in ``last_error``, and start the
+        cooldown (a failing compaction must not hot-loop) — the old
+        generation keeps serving either way."""
+        reason = self.due(now)
+        if reason is None:
+            return None
+        metrics = self.server.metrics
+        metrics.count("compactions_scheduled")
+        self._last_run = self.clock() if now is None else now
+        try:
+            self.server.swap_index(build=self._build)
+        except Exception as exc:  # noqa: BLE001 — background loop survives
+            metrics.count("compactions_failed")
+            self.last_error = exc
+            return None
+        metrics.count("compactions_completed")
+        self.last_error = None
+        return reason
+
+    # -- background loop ----------------------------------------------
+
+    def start(self) -> "CompactionScheduler":
+        expects(self._thread is None, "scheduler already started")
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="raft-tpu-compaction",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: Optional[float] = 30.0) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout)
+        self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self.run_once()
+            self._stop.wait(self.policy.poll_interval_s)
+
+    def __enter__(self) -> "CompactionScheduler":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

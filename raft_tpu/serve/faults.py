@@ -13,13 +13,24 @@ of a bench anecdote:
   build/extend retries, a dispatch retries after backoff);
 * ``fail``   — raises :class:`FaultError` (terminal: a generation swap
   wrapping it surfaces :class:`SwapFailed` and keeps the old
-  generation).
+  generation);
+* ``crash``  — SIGKILL-equivalent process abort (``os._exit(137)``, no
+  atexit, no finally, no flushing) — the durability drill: armed at the
+  ``wal_append``/``extend``/``snapshot``/``rename``/``compact`` sites it
+  kills a subprocess mid-operation so ``tests/test_durability.py`` can
+  prove ``DurableStore.recover`` restores a bit-identical index;
+* ``corrupt`` — flips one byte of the file/directory the site passed to
+  :meth:`FaultInjector.fire` (torn-write / bit-rot injection for the
+  checksum + quarantine paths).
 
-A :class:`FaultInjector` is armed per *site* (``"execute"``, ``"swap"``,
-``"extend"``) with a finite fire count, so tests express "the first two
-dispatches wedge, the third succeeds" exactly.  The server calls
-:meth:`FaultInjector.fire` at each site; an unarmed injector is a no-op
-(and the default), so production pays one dict lookup per dispatch.
+A :class:`FaultInjector` is armed per *site* (serve dispatch:
+``"execute"``, ``"swap"``, ``"extend"``; durability, fired by
+``neighbors.wal.DurableStore``: ``"wal_append"``, ``"snapshot"``,
+``"rename"``, ``"compact"``) with a finite fire count, so tests express
+"the first two dispatches wedge, the third succeeds" exactly.  The
+server calls :meth:`FaultInjector.fire` at each site; an unarmed
+injector is a no-op (and the default), so production pays one dict
+lookup per dispatch.
 
 ``RAFT_SERVE_FAULTS="site:kind[:times[:delay_ms]],..."`` arms an
 injector from the environment — the chaos-smoke hook for
@@ -28,6 +39,7 @@ injector from the environment — the chaos-smoke hook for
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Optional
@@ -35,7 +47,7 @@ from typing import Optional
 from .admission import ServeError
 
 __all__ = ["FaultError", "WedgedDevice", "DeviceOOM", "SwapFailed",
-           "TRANSIENT_FAULTS", "FaultInjector"]
+           "TRANSIENT_FAULTS", "FaultInjector", "CRASH_EXIT_CODE"]
 
 
 class FaultError(ServeError):
@@ -63,8 +75,35 @@ class SwapFailed(ServeError):
 #: deadline).
 TRANSIENT_FAULTS = (WedgedDevice, DeviceOOM)
 
-_KINDS = ("wedge", "slow", "oom", "fail")
-_SITES = ("execute", "swap", "extend")
+_KINDS = ("wedge", "slow", "oom", "fail", "crash", "corrupt")
+_SITES = ("execute", "swap", "extend",
+          "wal_append", "snapshot", "rename", "compact")
+
+#: the crash exit code (SIGKILL convention) the subprocess driver asserts
+CRASH_EXIT_CODE = 137
+
+
+def _corrupt_file(path: str) -> None:
+    """Flip one byte in the middle of ``path`` (for a directory: its
+    largest file — the slab, where a flip cannot hide).  Skips silently
+    when the target is missing/empty: the fault fired too early to have
+    anything to damage, which the test's fired-count assertion surfaces."""
+    if path is None or not os.path.exists(path):
+        return
+    if os.path.isdir(path):
+        files = [os.path.join(path, n) for n in os.listdir(path)]
+        files = [f for f in files if os.path.isfile(f)]
+        if not files:
+            return
+        path = max(files, key=os.path.getsize)
+    size = os.path.getsize(path)
+    if size == 0:
+        return
+    with open(path, "r+b") as f:
+        f.seek(size // 2)
+        byte = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([byte[0] ^ 0xFF]))
 
 
 class FaultInjector:
@@ -87,17 +126,29 @@ class FaultInjector:
                  sleep=time.sleep) -> "FaultInjector":
         """Build from ``RAFT_SERVE_FAULTS`` (or an explicit spec string):
         ``"execute:wedge:2,swap:fail"`` arms two wedges on dispatch and
-        one failed swap.  Empty/missing spec → unarmed injector."""
-        import os
+        one failed swap.  Empty/missing spec → unarmed injector.
+        Malformed entries fail loudly (``core.errors.expects``) — a chaos
+        drill that silently arms nothing would report a vacuous pass."""
+        from ..core.errors import expects
 
         inj = cls(sleep=sleep)
         spec = os.environ.get("RAFT_SERVE_FAULTS", "") if spec is None \
             else spec
         for part in filter(None, (p.strip() for p in spec.split(","))):
             bits = part.split(":")
-            site, kind = bits[0], bits[1]
-            times = int(bits[2]) if len(bits) > 2 else 1
-            delay = float(bits[3]) if len(bits) > 3 else 0.0
+            expects(2 <= len(bits) <= 4,
+                    f"malformed fault spec {part!r} — want "
+                    "site:kind[:times[:delay_ms]]")
+            site, kind = bits[0].strip(), bits[1].strip()
+            try:
+                times = int(bits[2]) if len(bits) > 2 else 1
+                delay = float(bits[3]) if len(bits) > 3 else 0.0
+            except ValueError:
+                from ..core.errors import RaftError
+
+                raise RaftError(
+                    f"malformed fault spec {part!r}: times must be an int "
+                    "and delay_ms a float") from None
             inj.arm(site, kind, times=times, delay_ms=delay)
         return inj
 
@@ -124,10 +175,13 @@ class FaultInjector:
         with self._lock:
             return len(self._armed.get(site, ()))
 
-    def fire(self, site: str) -> None:
+    def fire(self, site: str, *, path: Optional[str] = None) -> None:
         """Consume and enact the next armed fault at ``site`` (no-op when
         unarmed).  ``slow`` sleeps through the injected ``sleep`` (a fake
-        clock's sleep in tests); the rest raise."""
+        clock's sleep in tests); ``crash`` aborts the process like
+        SIGKILL (``os._exit`` — nothing flushes, nothing unwinds);
+        ``corrupt`` byte-flips ``path`` (the artifact the firing site is
+        about to publish/append) and returns; the rest raise."""
         with self._lock:
             queue = self._armed.get(site)
             if not queue:
@@ -137,6 +191,11 @@ class FaultInjector:
             self.fired[key] = self.fired.get(key, 0) + 1
         if kind == "slow":
             self._sleep(delay_ms / 1e3)
+            return
+        if kind == "crash":
+            os._exit(CRASH_EXIT_CODE)
+        if kind == "corrupt":
+            _corrupt_file(path)
             return
         if kind == "wedge":
             raise WedgedDevice(f"injected wedge at {site!r}")

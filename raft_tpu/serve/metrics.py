@@ -45,6 +45,14 @@ class ServingMetrics:
         self.failed_swaps = 0        # swaps rolled back (old gen kept)
         self.retries = 0             # dispatch retries after transient faults
         self.faulted_batches = 0     # batches rejected with retries exhausted
+        self.wal_appends = 0         # durable mutations logged (neighbors.wal)
+        self.wal_replayed = 0        # WAL records replayed during recovery
+        self.snapshots = 0           # crash-consistent snapshots published
+        self.quarantined_files = 0   # corrupt artifacts renamed aside
+        self.recoveries = 0          # DurableStore.recover completions
+        self.compactions_scheduled = 0  # scheduler trigger firings
+        self.compactions_completed = 0  # compaction + swap succeeded
+        self.compactions_failed = 0     # compaction attempts rolled back
         self.degrade_dispatches: dict = {}  # level -> batch count
 
     def count(self, field: str, n: int = 1) -> None:
@@ -85,6 +93,14 @@ class ServingMetrics:
                 "failed_swaps": self.failed_swaps,
                 "retries": self.retries,
                 "faulted_batches": self.faulted_batches,
+                "wal_appends": self.wal_appends,
+                "wal_replayed": self.wal_replayed,
+                "snapshots": self.snapshots,
+                "quarantined_files": self.quarantined_files,
+                "recoveries": self.recoveries,
+                "compactions_scheduled": self.compactions_scheduled,
+                "compactions_completed": self.compactions_completed,
+                "compactions_failed": self.compactions_failed,
                 "batch_fill_ratio": round(fill, 4),
                 "degrade_dispatches": {str(k): v for k, v in
                                        sorted(self.degrade_dispatches.items())},

@@ -14,6 +14,7 @@ Determinism hooks for tests: construct with a fake ``clock``, skip
 from __future__ import annotations
 
 import dataclasses
+import random
 import threading
 import time
 from concurrent.futures import Future
@@ -124,6 +125,10 @@ class SearchServer:
         self.faults = faults if faults is not None \
             else FaultInjector.from_env(sleep=sleep)
         self._sleep = sleep
+        # retry jitter draws from a seeded stream so fault tests replay
+        # exactly; distinct replicas pass distinct seeds to decorrelate
+        self._retry_rng = random.Random(self.seed ^ 0x9E3779B9)
+        self.durable_store = None  # neighbors.wal.DurableStore, if adopted
         self._log = default_logger() if res is None else None
         self._cond = threading.Condition()
         self._parts_lock = threading.Lock()
@@ -138,6 +143,38 @@ class SearchServer:
         read it once per use; a concurrent swap replaces the reference,
         never the object)."""
         return self._registry.current.index
+
+    # -- durability ---------------------------------------------------------
+
+    def adopt_store(self, store) -> None:
+        """Wire a ``neighbors.wal.DurableStore`` into this server: its
+        accumulated counters (``wal_appends``/``wal_replayed``/
+        ``quarantined_files``/``recoveries``/``snapshots``) transfer into
+        the serving metrics, future store activity counts live, and the
+        snapshot gains the WAL LSN watermark.  The store's index should
+        be (or become, via :meth:`swap_index`) the serving generation."""
+        self.durable_store = store
+        for name, n in store.counters.items():
+            self.metrics.count(name, n)
+        store.metrics = self.metrics
+
+    @classmethod
+    def recover(cls, root, k: int = 10, params=None, *,
+                store_config=None, **kw) -> "SearchServer":
+        """Restore a crashed durable deployment and resume serving:
+        ``DurableStore.recover(root)`` rebuilds the index (newest valid
+        snapshot + WAL-tail replay, corrupt artifacts quarantined), the
+        restored index becomes generation 0 of a fresh server, and the
+        store is adopted (counters + watermark).  Remaining ``kw`` are
+        :class:`SearchServer` constructor arguments; call ``start()`` (or
+        drive ``step()``) on the result as usual."""
+        from ..neighbors.wal import DurableStore
+
+        store = DurableStore.recover(root, config=store_config,
+                                     faults=kw.get("faults"))
+        srv = cls(store.index, k, params, **kw)
+        srv.adopt_store(store)
+        return srv
 
     @property
     def generation(self) -> int:
@@ -330,6 +367,7 @@ class SearchServer:
         qpad = pad_rows(np.concatenate([r.queries for r in batch], axis=0)
                         if len(batch) > 1 else batch[0].queries, bucket)
         retry = self.config.retry
+        backoffs = retry.start(self._retry_rng)
         attempt = 0
         while True:
             try:
@@ -349,7 +387,7 @@ class SearchServer:
                 break
             except TRANSIENT_FAULTS as exc:
                 attempt += 1
-                backoff = retry.backoff_s(attempt - 1)
+                backoff = backoffs.next_s()
                 earliest = min(r.deadline for r in batch)
                 if attempt > retry.max_retries:
                     self.metrics.count("faulted_batches")
@@ -405,6 +443,7 @@ class SearchServer:
         try:
             if build is not None:
                 attempt = 0
+                backoffs = retry.start(self._retry_rng)
                 while True:
                     try:
                         self.faults.fire("extend")
@@ -415,7 +454,7 @@ class SearchServer:
                         if attempt > retry.max_retries:
                             raise
                         self.metrics.count("retries")
-                        self._sleep(retry.backoff_s(attempt - 1))
+                        self._sleep(backoffs.next_s())
             self.faults.fire("swap")
             expects(family_of(new_index) == self.family,
                     f"swap changes index family ({self.family} -> "
@@ -496,7 +535,10 @@ class SearchServer:
             "server": {"family": self.family, "k": self.k,
                        "ladder": list(self.ladder),
                        "index_rows": index_size(self.index),
-                       "generation": self._registry.gen_id},
+                       "generation": self._registry.gen_id,
+                       "wal_lsn": (self.durable_store.wal_lsn
+                                   if self.durable_store is not None
+                                   else None)},
         })
         return snap
 

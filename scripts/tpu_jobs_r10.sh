@@ -1,0 +1,118 @@
+#!/bin/bash
+# Round-10 TPU job queue.  The r9 ladder plus the round-10 additions:
+#   * crash_recovery — tests/test_durability.py against the real
+#     backend: a subprocess is SIGKILL-equivalently aborted at every
+#     armed crash site (wal_append / extend / snapshot / rename /
+#     compact) and recovery must land bit-identically; corruption
+#     drills must quarantine, never parse.  Staged right after jaxlint
+#     alongside the chaos smoke — both are cheap and a failure means
+#     serving durability regressed, which should gate the expensive
+#     benches.
+#   * serve_recovery — bench/serve.py in recovery-time mode
+#     (RAFT_BENCH_SERVE_RECOVERY): restore + replay + first answered
+#     query, swept over WAL tail lengths — the on-hardware counterpart
+#     of the committed bench/RECOVERY_CPU.json snapshot-cadence curve.
+# Stage order: jaxlint -> chaos smoke -> crash recovery -> Mosaic check
+# -> build-throughput -> mutation throughput -> probe/chunk tuners ->
+# bench.py -> select_k tuner -> prims -> cagra tuner -> cagra quality ->
+# serve swap -> serve recovery -> int8 -> profile.
+# Markers stay in /tmp/tpu_jobs_r3 so steps completed by earlier rounds'
+# queues are not repeated and tpu_ab_r4.sh's wait-chain keeps working.
+set -u
+cd /root/repo || exit 1
+LOG=/tmp/tpu_jobs_r3
+mkdir -p "$LOG"
+. "$(dirname "$0")/tpu_queue_lib.sh"
+acquire_queue_lock tpu_jobs_r10
+
+export RAFT_BENCH_CKPT_DIR="$LOG/bench_ckpt"
+
+# un-latch a bench.done that lacks a headline measurement (r3/r4 queues
+# gated on any measured line; a wedged-headline run must be retried)
+if [ -f "$LOG/bench.done" ] && \
+    ! bench_measured "$LOG/bench.log" brute_force 2>/dev/null; then
+  echo "$(date) removing stale bench.done (no headline measurement)" \
+    >> "$LOG/driver.log"
+  rm -f "$LOG/bench.done"
+fi
+
+# r10 regrew the census (wal.py/compaction.py scanned; the brute-compact
+# rewrite shifted mutation.py's waiver lines): a pre-r10 jaxlint.done
+# would leave the stale census committed
+if [ -f "$LOG/jaxlint.done" ] && \
+    ! grep -q "mutation.py:112" bench/JAXLINT.json 2>/dev/null; then
+  echo "$(date) removing pre-r10 jaxlint.done (stale waiver census)" \
+    >> "$LOG/driver.log"
+  rm -f "$LOG/jaxlint.done"
+fi
+
+echo "$(date) [r10 queue] waiting for TPU..." >> "$LOG/driver.log"
+wait_probe
+echo "$(date) TPU is back" >> "$LOG/driver.log"
+
+run_step() {  # name, timeout_s, command...   (two attempts, gated .done)
+  local name=$1 tmo=$2; shift 2
+  [ -f "$LOG/$name.done" ] && return 0
+  local attempt
+  for attempt in 1 2; do
+    echo "$(date) start $name (attempt $attempt)" >> "$LOG/driver.log"
+    timeout "$tmo" "$@" > "$LOG/$name.$attempt.log" 2>&1 9<&-
+    rc=$?
+    cp -f "$LOG/$name.$attempt.log" "$LOG/$name.log"  # latest = canonical
+    if [ "$rc" -eq 0 ]; then
+      if [ "$name" != bench ] || bench_measured "$LOG/$name.log" brute_force; then
+        touch "$LOG/$name.done"
+        echo "$(date) done $name" >> "$LOG/driver.log"
+        return 0
+      fi
+      echo "$(date) $name exited 0 with no headline measurement (wedged backend)" \
+        >> "$LOG/driver.log"
+    else
+      echo "$(date) FAILED $name (rc=$rc)" >> "$LOG/driver.log"
+    fi
+    # a killed/wedged client can poison the tunnel for the next step too:
+    # re-probe before the retry (or before handing on to the next step)
+    wait_probe
+  done
+}
+
+# jaxlint first: pure-host AST pass, ~seconds, zero chip time — a hazard
+# (hidden sync, retrace loop, f64 leak) must never cost TPU minutes to find
+run_step jaxlint        300 python scripts/mini_lint.py --jax raft_tpu --stats-json bench/JAXLINT.json
+# chaos smoke: small index, short sweep, faults armed — two wedged
+# dispatches (recovered by retry) and one failed swap (rolled back).
+run_step chaos_smoke    900 env RAFT_SERVE_FAULTS="execute:wedge:2,swap:fail" \
+    RAFT_BENCH_SERVE_ROWS=20000 RAFT_BENCH_SERVE_SECONDS=2 \
+    RAFT_BENCH_SERVE_CLIENTS=2,4 RAFT_BENCH_SERVE_SWAPS=2 \
+    python bench/serve.py
+# crash-recovery smoke: every armed crash site killed mid-operation must
+# recover bit-identically, corruption must quarantine (subprocess drills)
+run_step crash_recovery 1200 python -m pytest tests/test_durability.py \
+    tests/test_wal.py tests/test_compaction.py -q -p no:cacheprovider
+run_step mosaic         900 env RAFT_MOSAIC_REQUIRE_TPU=1 python scripts/mosaic_check.py
+run_step build_tp      2400 python bench/build_throughput.py
+run_step mutation_tp   2400 python bench/mutation_throughput.py
+# tuners before the big benches: all three have /tmp resume checkpoints
+# (kernel-sha scoped), so a wedge mid-grid resumes on attempt 2
+run_step probe_tuner   3000 python bench/tune_probe_block.py
+run_step chunk_tuner   3000 python bench/tune_chunk_rows.py
+run_step bench         4500 python bench.py
+# the checkpoints exist to survive a wedge WITHIN a bench run; once the
+# headline-gated .done latches they are spent — leaving them would turn a
+# deliberately forced re-measurement (rm bench.done) into a silent replay
+[ -f "$LOG/bench.done" ] && rm -rf "$RAFT_BENCH_CKPT_DIR"
+run_step tuner         3000 python bench/tune_select_k.py
+run_step prims         3000 python bench/prims.py
+# cagra tuner immediately before the quality sweep: the sweep's auto
+# (itopk=0/width=0) points must consult the table this run just measured
+run_step cagra_tuner   3000 python bench/tune_cagra.py
+run_step cagra_quality 3000 python bench/cagra_quality.py
+# swap-under-load at bench scale, no faults: the recorded handoff numbers
+# (drops, p95 during swap, recompiles) for the round artifact
+run_step serve_swap    2400 env RAFT_BENCH_SERVE_SWAPS=3 python bench/serve.py
+# recovery-time curve at bench scale: restore + replay vs WAL tail length
+run_step serve_recovery 2400 env RAFT_BENCH_SERVE_RECOVERY=0,64,256 \
+    RAFT_BENCH_SERVE_ROWS=100000 python bench/serve.py
+run_step int8          1500 python scripts/tpu_validate_int8.py
+run_step profile       3000 python bench/profile_knn.py
+echo "$(date) all steps attempted" >> "$LOG/driver.log"

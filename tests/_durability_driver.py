@@ -1,0 +1,117 @@
+"""Shared crash/recover driver for ``tests/test_durability.py``.
+
+Runs in two roles with the SAME deterministic op schedule:
+
+* **child** (``python tests/_durability_driver.py``, env ``DUR_ROOT`` +
+  ``DUR_SITE``): builds the seed index, publishes the initial snapshot,
+  arms a ``crash`` fault at ``DUR_SITE``, then walks the op list writing
+  an atomically-renamed progress marker *before* each op.  The armed
+  site kills the process mid-operation (``os._exit(137)`` — nothing
+  flushes, nothing unwinds), exactly like ``kill -9``.
+* **parent** (imported by the test): replays the same schedule against a
+  fault-free store to produce the expected-state ladder
+  ``states[m]`` = index after the first ``m`` ops, which the recovered
+  child store is compared against bit-for-bit.
+
+Keeping both roles in one module is the determinism guarantee: the
+child's mutations and the parent's expectations are the same code.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+N, D = 192, 8
+OP_COUNT = 7
+
+
+def initial_tombstoned():
+    from raft_tpu.neighbors import ivf_flat, mutation
+
+    rng = np.random.default_rng(7)
+    db = rng.standard_normal((N, D)).astype(np.float32)
+    idx = ivf_flat.build(db, ivf_flat.IvfFlatIndexParams(n_lists=4, seed=0))
+    return mutation.delete(idx, [2], id_space=2048)
+
+
+def op_list():
+    """The mutation schedule — hits every crash site: ``extend``/
+    ``delete`` (wal_append + extend sites), ``compact`` (compact site),
+    ``snapshot`` (snapshot + rename sites)."""
+    orng = np.random.default_rng(11)
+    ops = [
+        ("extend", (orng.standard_normal((16, D)).astype(np.float32),)),
+        ("delete", ([5, 9],)),
+        ("snapshot", ()),
+        ("extend", (orng.standard_normal((8, D)).astype(np.float32),)),
+        ("compact", ()),
+        ("delete", ([30, 31],)),
+        ("snapshot", ()),
+    ]
+    assert len(ops) == OP_COUNT
+    return ops
+
+
+def apply_op(store, op, args):
+    if op == "extend":
+        store.extend(*args)
+    elif op == "delete":
+        store.delete(*args)
+    elif op == "compact":
+        store.compact()
+    elif op == "snapshot":
+        store.snapshot()
+    else:  # pragma: no cover — schedule typo guard
+        raise ValueError(op)
+
+
+def expected_states(root):
+    """``states[m]`` = the committed index after ops ``[0, m)`` (so
+    ``states[0]`` is the freshly-created store), built with NO faults."""
+    from raft_tpu.neighbors import wal
+
+    store = wal.DurableStore.create(root, initial_tombstoned())
+    states = [store.index]
+    for op, args in op_list():
+        apply_op(store, op, args)
+        states.append(store.index)
+    store.close()
+    return states
+
+
+def child_main():
+    from raft_tpu.neighbors import wal
+    from raft_tpu.serve.faults import FaultInjector
+
+    root = os.environ["DUR_ROOT"]
+    site = os.environ["DUR_SITE"]
+    store = wal.DurableStore.create(root, initial_tombstoned())
+    # arm AFTER the initial snapshot: the drill is crashing a healthy
+    # store mid-mutation, not failing to be born
+    store.faults = FaultInjector().arm(site, "crash")
+    marker = os.path.join(root, "progress")
+    for m, (op, args) in enumerate(op_list()):
+        tmp = marker + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(m))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, marker)
+        apply_op(store, op, args)
+    raise SystemExit(3)  # fault never fired — the parent asserts 137
+
+
+if __name__ == "__main__":
+    # mirror conftest.py: the axon PJRT plugin ignores JAX_PLATFORMS, so
+    # force CPU programmatically before backends initialize, with the
+    # same 8-virtual-device topology the parent builds under
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = \
+            (_flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    child_main()

@@ -1,0 +1,186 @@
+"""Subprocess crash/recover drills + corruption injection (ISSUE 7).
+
+The acceptance criteria these pin:
+
+* for EVERY armed crash site (``wal_append``, ``extend``, ``snapshot``,
+  ``rename``, ``compact``) a SIGKILL-style abort mid-operation recovers
+  to an index bit-identical (values AND ids) to the pre-crash committed
+  state — the exact rung of the expected-state ladder the WAL contract
+  promises — and ``SearchServer.recover()`` serves immediately after;
+* ``corrupt``-kind faults (byte-flips into snapshot/WAL artifacts) are
+  caught by checksums, quarantined (never parsed), and recovery falls
+  back with ``quarantined_files`` > 0.
+
+The child process lives in ``tests/_durability_driver.py`` — the same
+module computes the parent's expected states, so child mutations and
+parent expectations are one code path.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+import _durability_driver as driver  # noqa: E402
+
+from raft_tpu.neighbors import mutation  # noqa: E402
+from raft_tpu.neighbors.wal import DurableStore, WalConfig, read_wal  # noqa: E402
+from raft_tpu.serve import (CRASH_EXIT_CODE, FaultInjector,  # noqa: E402
+                            SearchServer, ServerConfig)
+
+D = driver.D
+
+
+def _leaves(tree):
+    return [np.asarray(jax.device_get(x))
+            for x in jax.tree_util.tree_leaves(tree)]
+
+
+def assert_bit_identical(a, b):
+    la, lb = _leaves(a), _leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        assert x.dtype == y.dtype and x.shape == y.shape
+        np.testing.assert_array_equal(x, y)
+
+
+@pytest.fixture(scope="module")
+def states(tmp_path_factory):
+    """The fault-free expected-state ladder (built once per module)."""
+    return driver.expected_states(
+        tmp_path_factory.mktemp("expected") / "store")
+
+
+def _run_child(root, site):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, DUR_ROOT=str(root), DUR_SITE=site,
+               PYTHONPATH=os.pathsep.join(
+                   p for p in (repo, os.environ.get("PYTHONPATH")) if p))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__),
+                                      "_durability_driver.py")],
+        env=env, capture_output=True, text=True, timeout=540)
+    assert proc.returncode == CRASH_EXIT_CODE, \
+        f"child should die at the armed {site!r} site " \
+        f"(rc={proc.returncode}):\n{proc.stderr[-2000:]}"
+    m = int((root / "progress").read_text())
+    return m
+
+
+# site -> which ladder rung the recovered index must equal, relative to
+# the op the child was inside when it died (marker m):
+#   wal_append fires BEFORE the record is written -> the op is lost (m);
+#   extend/compact fire AFTER the fsynced append  -> replay includes it
+#   (m+1); snapshot/rename crash while publishing -> the index itself is
+#   unchanged by a snapshot op (m == m+1 there), previous snapshot +
+#   longer replay must land on it.
+_SITES = [("wal_append", 0), ("extend", 1), ("compact", 1),
+          ("snapshot", 0), ("rename", 0)]
+
+
+@pytest.mark.parametrize("site,offset", _SITES,
+                         ids=[s for s, _ in _SITES])
+def test_crash_recovery_bit_identical_per_site(site, offset, states,
+                                               tmp_path):
+    root = tmp_path / "store"
+    m = _run_child(root, site)
+    srv = SearchServer.recover(root, k=3, config=ServerConfig(ladder=(4,)))
+    store = srv.durable_store
+    assert_bit_identical(states[m + offset], store.index)
+    if site in ("snapshot", "rename"):
+        # the half-published temp snapshot was quarantined, not trusted
+        assert store.counters["quarantined_files"] >= 1
+        assert os.listdir(root / "quarantine")
+    # search serves immediately after recover(), against the recovered
+    # generation, bit-identical to a direct search on the expected state
+    q = np.random.default_rng(13).standard_normal((3, D)).astype(np.float32)
+    d_srv, i_srv = srv.search(q)
+    d_ref, i_ref = mutation.search(states[m + offset], q, 3) \
+        if isinstance(states[m + offset], mutation.Tombstoned) else (None,
+                                                                     None)
+    assert d_ref is not None  # every ladder rung stays tombstoned
+    np.testing.assert_array_equal(np.asarray(d_srv),
+                                  np.asarray(jax.device_get(d_ref)))
+    np.testing.assert_array_equal(np.asarray(i_srv),
+                                  np.asarray(jax.device_get(i_ref)))
+    assert srv.metrics.recoveries == 1
+    assert srv.metrics_snapshot()["server"]["wal_lsn"] == store.wal_lsn
+    store.close()
+
+
+def test_crash_recovered_store_keeps_mutating(states, tmp_path):
+    """Recovery is a beginning, not a postmortem: the recovered store
+    accepts new durable mutations and a SECOND recovery sees them."""
+    root = tmp_path / "store"
+    _run_child(root, "compact")
+    store = DurableStore.recover(root)
+    store.delete([100, 101])
+    store.snapshot()
+    live = store.index
+    store.close()
+    again = DurableStore.recover(root)
+    assert_bit_identical(live, again.index)
+    assert again.counters["wal_replayed"] == 0  # snapshot caught up
+    again.close()
+
+
+# ---------------------------------------------------------------------------
+# corrupt-kind fault injection
+
+
+def test_corrupt_fault_on_snapshot_quarantined_on_recover(tmp_path):
+    root = tmp_path / "store"
+    store = DurableStore.create(root, driver.initial_tombstoned(),
+                                config=WalConfig(retain_snapshots=4))
+    store.delete([7, 8])
+    store.snapshot()  # good fallback snapshot
+    store.delete([9])
+    store.faults = FaultInjector().arm("snapshot", "corrupt")
+    store.snapshot()  # byte-flipped while staged, then published
+    live = store.index
+    store.close()
+    rec = DurableStore.recover(root)
+    # checksums caught the flip: newest snapshot quarantined, fell back
+    # to the previous good one + longer replay, landing on the live state
+    assert rec.counters["quarantined_files"] == 1
+    assert rec.counters["wal_replayed"] == 1
+    assert_bit_identical(live, rec.index)
+    q = [n for n in os.listdir(root / "quarantine")
+         if n.startswith("snap-") and not n.endswith(".reason")]
+    assert len(q) == 1
+    rec.close()
+
+
+def test_corrupt_fault_on_wal_quarantines_tail(tmp_path):
+    root = tmp_path / "store"
+    store = DurableStore.create(root, driver.initial_tombstoned())
+    store.delete([7])
+    store.delete([8])
+    store.delete([9])
+    # the corrupt fires BEFORE the next append: mid-log byte flip, then
+    # the new record lands after it — a prefix survives, the rest is tail
+    store.faults = FaultInjector().arm("wal_append", "corrupt")
+    store.delete([10])
+    store.close()
+    records, good_end, problems = read_wal(root / "wal.log")
+    assert problems and len(records) < 4
+    rec = DurableStore.recover(root)
+    assert rec.counters["quarantined_files"] == 1
+    assert rec.counters["wal_replayed"] == len(records)
+    # recovered == snapshot + surviving prefix, and the log is clean again
+    clean, _, clean_problems = read_wal(root / "wal.log")
+    assert clean_problems == [] and len(clean) == len(records)
+    assert any(n.startswith("wal-tail-")
+               for n in os.listdir(root / "quarantine"))
+    rec.close()
+
+
+def test_recover_requires_a_snapshot(tmp_path):
+    with pytest.raises(Exception):
+        DurableStore.recover(tmp_path / "nothing-here")

@@ -333,12 +333,19 @@ def test_compact_ivf_pq_rederives_tiers(db, queries):
     np.testing.assert_array_equal(np.asarray(i_t), np.asarray(i_c))
 
 
-def test_compact_refuses_positional_families(db):
+def test_compact_refuses_graphs_and_bad_headroom(db):
     cg = cagra.build(db, cagra.CagraIndexParams(graph_degree=8))
-    with pytest.raises(RaftError):
+    with pytest.raises(RaftError):  # graph edges are positional: rebuild
         compact(delete(cg, [1]))
-    with pytest.raises(RaftError):
-        compact(delete(db, [1]))
     with pytest.raises(RaftError):
         compact(delete(ivf_flat.build(db, ivf_flat.IvfFlatIndexParams(
             n_lists=8)), [1]), headroom=0.5)
+
+
+def test_compact_brute_force_drops_rows(db):
+    # brute compact is real since the durability PR: kept rows gather
+    # into a dense array with positional renumbering (row i = old
+    # kept[i]); the deeper equality checks live in tests/test_wal.py
+    c = compact(delete(db, [1]))
+    assert c.shape == (N - 1, db.shape[1])
+    np.testing.assert_array_equal(np.asarray(c[1]), db[2])

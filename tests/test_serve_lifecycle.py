@@ -97,7 +97,9 @@ def test_wedge_recovery_retries_then_answers(built, queries):
     assert snap["retries"] == 2 and snap["faulted_batches"] == 0
     assert snap["completed"] == 1
     assert len(sleep.calls) == 2
-    assert sleep.calls[1] > sleep.calls[0]  # exponential backoff
+    p = srv.config.retry  # decorrelated jitter stays inside the hard bounds
+    assert all(p.backoff_ms / 1e3 <= s <= p.max_backoff_ms / 1e3
+               for s in sleep.calls)
 
 
 def test_retry_exhaustion_fails_batch_not_server(built, queries):
@@ -144,6 +146,83 @@ def test_fault_injector_env_spec(built, queries):
         FaultInjector().arm("nowhere", "wedge")
     with pytest.raises(RaftError):
         FaultInjector().arm("execute", "sparks")
+
+
+def test_fault_spec_multi_site_arming():
+    inj = FaultInjector.from_env("execute:wedge:2,swap:fail,extend:oom:3")
+    assert inj.pending("execute") == 2
+    assert inj.pending("swap") == 1
+    assert inj.pending("extend") == 3
+    assert inj.pending("snapshot") == 0  # durability sites arm too
+    inj2 = FaultInjector.from_env("snapshot:crash,rename:corrupt:2")
+    assert inj2.pending("snapshot") == 1
+    assert inj2.pending("rename") == 2
+
+
+def test_fault_spec_empty_and_whitespace_are_unarmed():
+    for spec in ("", "  ", ",", " , "):
+        inj = FaultInjector.from_env(spec)
+        assert all(inj.pending(s) == 0
+                   for s in ("execute", "swap", "extend"))
+
+
+@pytest.mark.parametrize("spec", [
+    "execute",                       # missing kind
+    "execute:wedge:1:0:extra",       # too many fields
+    "execute:wedge:one",             # non-int times
+    "execute:slow:1:fast",           # non-float delay
+    "nowhere:wedge",                 # unknown site
+    "execute:sparks",                # unknown kind
+])
+def test_fault_spec_malformed_raises(spec):
+    with pytest.raises(RaftError):
+        FaultInjector.from_env(spec)
+
+
+# ---------------------------------------------------------------------------
+# retry backoff: decorrelated jitter
+
+
+def test_backoff_jitter_bounds_and_hard_cap():
+    import random
+
+    p = RetryPolicy(max_retries=8, backoff_ms=10.0, max_backoff_ms=50.0)
+    draws = []
+    for seed in range(20):
+        b = p.start(random.Random(seed))
+        draws.extend(b.next_s() for _ in range(8))
+    lo, hi = p.backoff_ms / 1e3, p.max_backoff_ms / 1e3
+    assert all(lo <= s <= hi for s in draws)   # hard cap, both sides
+    assert len({round(s, 6) for s in draws}) > 10  # it actually jitters
+    assert max(draws) <= hi + 1e-12
+
+
+def test_backoff_decorrelated_desynchronizes_replicas():
+    import random
+
+    p = RetryPolicy(max_retries=4, backoff_ms=5.0, max_backoff_ms=1000.0)
+    a = [p.start(random.Random(1)).next_s() for _ in range(1)]
+    seqs = [[p.start(random.Random(s)).next_s() for _ in range(3)]
+            for s in range(8)]
+    # two replicas retrying the same shared fault should not share a
+    # schedule (the retry-storm failure mode jitter exists to break)
+    assert len({tuple(round(x, 9) for x in s) for s in seqs}) == 8
+    assert a  # non-empty draw from the same API
+
+
+def test_backoff_jitter_none_matches_exponential_envelope():
+    p = RetryPolicy(max_retries=4, backoff_ms=5.0, multiplier=2.0,
+                    max_backoff_ms=100.0, jitter="none")
+    b = p.start()
+    got = [b.next_s() for i in range(6)]
+    want = [p.backoff_s(i) for i in range(6)]
+    assert got == want
+    assert got[-1] == 0.1  # capped
+
+
+def test_retry_policy_rejects_unknown_jitter():
+    with pytest.raises(RaftError):
+        RetryPolicy(jitter="bogus")
 
 
 # ---------------------------------------------------------------------------
