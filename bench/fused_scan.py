@@ -1,0 +1,207 @@
+"""Blocked-scan A/B driver for the shared ``ops/blocked_scan.py`` core.
+
+Times every neighbors family's blocked search path through the public
+API, so the same script measures the tree before and after an engine
+refactor.  Arms accumulate into one JSON: run once on the pre-refactor
+tree with ``--tag per_engine``, once on the refactored tree with
+``--tag shared_core``, and the script emits the ratio table whenever
+both arms are present.  The committed CPU acceptance artifact is
+``bench/FUSED_SCAN_CPU.json``:
+
+    python bench/fused_scan.py --cpu --tag per_engine  --out /tmp/FUSED_SCAN_CPU.json
+    ... refactor ...
+    python bench/fused_scan.py --cpu --tag shared_core --out /tmp/FUSED_SCAN_CPU.json
+
+On CPU the fused Pallas arm runs in ``interpret=True`` mode, which is a
+parity check, not a performance number — it is recorded under
+``fused_interpret`` with that caveat, and the real MXU timing stays
+staged in ``scripts/tpu_jobs_r11.sh``.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.expanduser("~/.cache/raft_tpu_jax"))
+
+import jax
+
+from _platform import pin_backend
+
+# MUST precede any backend use (see tune_select_k.py)
+pin_backend(sys.argv)
+
+import numpy as np
+
+from _timing import timeit as _time
+from ann import make_clustered
+from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq
+
+DIM, NQ, K = 64, 256, 10
+IVF_ROWS, IVF_LISTS, N_PROBES, PROBE_BLOCK = 60_000, 128, 32, 8
+BF_ROWS = 20_000
+CAGRA_ROWS, ITOPK, WIDTH = 20_000, 64, 4
+
+
+def kernel_sha() -> str:
+    """Hash of every source file the timed paths run through (missing
+    files — e.g. ``ops/blocked_scan.py`` on the pre-refactor tree — are
+    skipped so the before/after arms get distinct, honest shas)."""
+    import hashlib
+
+    root = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+    h = hashlib.sha256()
+    for rel in ("raft_tpu/neighbors/ivf_flat.py",
+                "raft_tpu/neighbors/ivf_pq.py",
+                "raft_tpu/neighbors/cagra.py",
+                "raft_tpu/neighbors/brute_force.py",
+                "raft_tpu/neighbors/_packing.py",
+                "raft_tpu/matrix/select_k.py",
+                "raft_tpu/ops/blocked_scan.py",
+                "raft_tpu/ops/pallas/fused_scan.py",
+                "raft_tpu/ops/pallas/gate.py"):
+        try:
+            with open(os.path.join(root, rel), "rb") as f:
+                h.update(f.read())
+        except OSError:
+            h.update(b"<absent>")
+    return h.hexdigest()[:16]
+
+
+def _measure_arms() -> dict:
+    arms: dict = {}
+    rng_q = 0.1
+
+    x = make_clustered(IVF_ROWS + NQ, DIM, 256, seed=3, scale=2.0)
+    db, q = x[:IVF_ROWS], jax.device_put(x[IVF_ROWS:])
+
+    fi = ivf_flat.build(db, ivf_flat.IvfFlatIndexParams(
+        n_lists=IVF_LISTS, list_cap_ratio=1.5,
+        kmeans_trainset_fraction=0.05, seed=0))
+    fp = ivf_flat.IvfFlatSearchParams(n_probes=N_PROBES,
+                                      probe_block=PROBE_BLOCK)
+    arms["ivf_flat"] = _time(lambda: ivf_flat.search(fi, q, K, fp))
+    print(f"ivf_flat        {arms['ivf_flat'] * 1e3:8.1f} ms")
+
+    pi = ivf_pq.build(db, ivf_pq.IvfPqIndexParams(
+        n_lists=IVF_LISTS, pq_dim=16, list_cap_ratio=1.5,
+        kmeans_trainset_fraction=0.05, seed=0))
+    for mode in ("recon", "lut"):
+        pp = ivf_pq.IvfPqSearchParams(n_probes=N_PROBES, mode=mode,
+                                      probe_block=PROBE_BLOCK)
+        arms[f"ivf_pq_{mode}"] = _time(lambda: ivf_pq.search(pi, q, K, pp))
+        print(f"ivf_pq_{mode:5s}    {arms[f'ivf_pq_{mode}'] * 1e3:8.1f} ms")
+
+    xb = make_clustered(BF_ROWS + NQ, DIM, 64, seed=3, scale=2.0)
+    bdb, bq = jax.device_put(xb[:BF_ROWS]), jax.device_put(xb[BF_ROWS:])
+    arms["brute_force"] = _time(lambda: brute_force.knn(bdb, bq, K))
+    print(f"brute_force     {arms['brute_force'] * 1e3:8.1f} ms")
+
+    xc = make_clustered(CAGRA_ROWS + NQ, DIM, 100, seed=3, scale=2.0)
+    cdb, cq = xc[:CAGRA_ROWS], jax.device_put(xc[CAGRA_ROWS:])
+    ci = cagra.build(cdb, cagra.CagraIndexParams(
+        intermediate_graph_degree=64, graph_degree=32))
+    cp = cagra.CagraSearchParams(itopk_size=ITOPK, search_width=WIDTH,
+                                 search_impl="frontier")
+    arms["cagra"] = _time(lambda: cagra.search(ci, cq, K, cp))
+    print(f"cagra           {arms['cagra'] * 1e3:8.1f} ms")
+    del rng_q
+    return arms
+
+
+def _fused_interpret_check() -> dict | None:
+    """Tiny interpret-mode run of the fused slab kernel (post-refactor
+    trees only): records that the arm exists and agrees with the XLA
+    fold — wall-clock in interpret mode is NOT a perf number."""
+    try:
+        from raft_tpu.ops.blocked_scan import fold_topk
+        from raft_tpu.ops.pallas.fused_scan import fused_slab_topk
+    except ImportError:
+        return None
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    nq, c, d, k = 8, 256, DIM, K
+    vecs = jnp.asarray(rng.standard_normal((nq, c, d)), jnp.float32)
+    qv = jnp.asarray(rng.standard_normal((nq, d)), jnp.float32)
+    base = jnp.sum(vecs.astype(jnp.float32) ** 2, axis=-1)
+    t = _time(lambda: fused_slab_topk(vecs, base, qv, interpret=True))
+    sv, spos = fused_slab_topk(vecs, base, qv, interpret=True)
+    init_v = jnp.full((nq, k), jnp.inf, jnp.float32)
+    init_i = jnp.full((nq, k), -1, jnp.int32)
+    fv, fo = fold_topk(init_v, init_i, sv, spos, k)
+    exact = base - 2.0 * jnp.einsum("ncd,nd->nc", vecs, qv,
+                                    preferred_element_type=jnp.float32)
+    ev, ei = jax.lax.top_k(-exact, k)
+    agree = float(np.mean([len(set(np.asarray(fo[i])) & set(np.asarray(ei[i])))
+                           for i in range(nq)])) / k
+    return {"interpret_s": t, "nq": nq, "c": c, "d": d, "k": k,
+            "shortlist_recall_vs_exact": round(agree, 4),
+            "note": "interpret=True parity probe; not a perf number — "
+                    "MXU timing staged in scripts/tpu_jobs_r11.sh"}
+
+
+def main() -> None:
+    tag = "shared_core"
+    if "--tag" in sys.argv:
+        tag = sys.argv[sys.argv.index("--tag") + 1]
+    backend = jax.default_backend()
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       f"FUSED_SCAN_{backend.upper()}.json")
+    if "--out" in sys.argv:
+        out = sys.argv[sys.argv.index("--out") + 1]
+
+    doc: dict = {"backend": backend, "arms": {}}
+    try:
+        with open(out) as f:
+            prior = json.load(f)
+        if prior.get("backend") == backend:
+            doc = prior
+    except (OSError, ValueError):
+        pass
+
+    print(f"backend={backend} tag={tag}")
+    doc["arms"][tag] = _measure_arms()
+    doc["date"] = datetime.date.today().isoformat()
+    shas = doc.get("kernel_sha")
+    shas = dict(shas) if isinstance(shas, dict) else {}
+    shas[tag] = kernel_sha()
+    doc["kernel_sha"] = shas
+    doc["config"] = {"dim": DIM, "nq": NQ, "k": K, "ivf_rows": IVF_ROWS,
+                     "n_lists": IVF_LISTS, "n_probes": N_PROBES,
+                     "probe_block": PROBE_BLOCK, "bf_rows": BF_ROWS,
+                     "cagra_rows": CAGRA_ROWS, "itopk": ITOPK,
+                     "search_width": WIDTH}
+
+    fused = _fused_interpret_check()
+    if fused is not None:
+        doc["fused_interpret"] = fused
+
+    per, shared = doc["arms"].get("per_engine"), doc["arms"].get("shared_core")
+    if per and shared:
+        doc["ab"] = {
+            fam: {"per_engine_s": per[fam], "shared_core_s": shared[fam],
+                  "speedup": round(per[fam] / shared[fam], 3)}
+            for fam in sorted(set(per) & set(shared))}
+        doc["note"] = ("shared_core is the ops/blocked_scan.py refactor; "
+                       "speedup >= ~1.0 means the shared core is no slower "
+                       "than the per-engine scan paths it replaced")
+        for fam, row in doc["ab"].items():
+            print(f"A/B {fam:12s} {row['per_engine_s'] * 1e3:8.1f} ms → "
+                  f"{row['shared_core_s'] * 1e3:8.1f} ms "
+                  f"(x{row['speedup']:.3f})")
+
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {os.path.normpath(out)}")
+
+
+if __name__ == "__main__":
+    main()

@@ -29,7 +29,10 @@ __all__ = ["pack_lists", "chunked_queries", "chunked_filtered_queries",
            "chunked_shard_rows", "chunked_shard_trainsets",
            "blocked_probe_plan", "resolve_probe_block",
            "resolve_chunk_rows", "resolve_cagra_search",
-           "DEFAULT_INSERT_CHUNK", "host_rows", "staged_insert_chunks"]
+           "DEFAULT_INSERT_CHUNK", "host_rows", "staged_insert_chunks",
+           # re-exports from ops.blocked_scan (the scoring-tier rule moved
+           # to the scan core; existing call sites keep this import path)
+           "exact_gathered_dots", "int8_tier_eligible"]
 
 
 def prefetch_chunks(dataset, chunk_rows: int, ids=None):
@@ -227,40 +230,13 @@ def check_filter_covers_ids(keep, ids):
             f"filter covers {keep.shape[-1]} ids, index ids reach {max_id}")
 
 
-def int8_tier_eligible(a, b, d: int) -> bool:
-    """True when the single-pass bf16 scoring tier is EXACT for a·b dots
-    over contraction length ``d`` — the ONE home of the eligibility rule
-    (every call site must agree or a raw integer query silently reverts a
-    path to the 6× slower HIGHEST einsum).
-
-    Exactness needs every f32 partial sum to stay an exact integer
-    (< 2²⁴): uint8 products reach 255² ⇒ d ≤ 256; int8 reach 128² ⇒
-    d ≤ 1024.  Beyond the bound integer dot gaps of 1 could round away —
-    HIGHEST was exact there, so the tier must not regress it."""
-    kinds = (jnp.uint8, jnp.int8)
-    if a.dtype not in kinds or b.dtype not in kinds:
-        return False
-    lim = 256 if jnp.uint8 in (a.dtype, b.dtype) else 1024
-    return d <= lim
-
-
-def exact_gathered_dots(subscripts: str, vecs, q):
-    """Query·candidate dots for gathered rows — the shared scoring einsum
-    of the IVF-Flat probe scan, the CAGRA beam step, and the brute-force
-    exact/refine paths.
-
-    Eligible 8-bit corpora (:func:`int8_tier_eligible`) take ONE bf16 MXU
-    pass: the values are bf16-exact and the MXU accumulates products in
-    f32, so the result matches the f32 path exactly at ~6× the MXU rate of
-    ``Precision.HIGHEST``.  Everything else keeps the bf16x6 HIGHEST
-    passes — a single pass would genuinely lose ranking precision there."""
-    if int8_tier_eligible(vecs, q, int(vecs.shape[-1])):
-        return jnp.einsum(subscripts, vecs.astype(jnp.bfloat16),
-                          q.astype(jnp.bfloat16),
-                          preferred_element_type=jnp.float32)
-    return jnp.einsum(subscripts, vecs, q,
-                      preferred_element_type=jnp.float32,
-                      precision=jax.lax.Precision.HIGHEST)
+# the scoring-tier rule and the gathered-dots einsum moved to the shared
+# blocked-scan core (ops must not import neighbors); re-exported here for
+# the existing call sites and tests
+from ..ops.blocked_scan import (  # noqa: E402
+    exact_gathered_dots as exact_gathered_dots,
+    int8_tier_eligible as int8_tier_eligible,
+)
 
 
 def keep_lookup(keep, vids):

@@ -62,8 +62,8 @@ def _tile_distances(x, yt, metric: str, xn=None):
     # HIGHEST: default bf16 MXU passes are coarser than neighbor gaps —
     # except for 8-bit corpora, where one bf16 pass is already exact
     # (values are bf16-exact, products accumulate in f32; see
-    # _packing.exact_gathered_dots) at ~6x the MXU rate
-    from ._packing import exact_gathered_dots
+    # ops.blocked_scan.exact_gathered_dots) at ~6x the MXU rate
+    from ..ops.blocked_scan import exact_gathered_dots
 
     dots = exact_gathered_dots("md,nd->mn", x, yt)
     if metric == "inner_product":
@@ -73,19 +73,9 @@ def _tile_distances(x, yt, metric: str, xn=None):
     return _metric_from_dots(dots, xn, yn[None, :], metric)
 
 
-def tile_knn_merge(best_val, best_idx, tile_val, tile_idx, k: int, *,
-                   sorted: bool = True):
-    """Merge a new candidate block into the running (m, k) best buffers via
-    ``matrix.select_k`` — one selection primitive owns all top-k tuning.
-
-    ``sorted=False`` keeps the carry an unordered top-k set (exact values
-    and ids, unspecified row order) — the right form for intermediate scan
-    carries, where only the FINAL merge needs ranked output."""
-    from ..matrix.select_k import select_k
-
-    vals = jnp.concatenate([best_val, tile_val], axis=1)
-    idxs = jnp.concatenate([best_idx, tile_idx], axis=1)
-    return select_k(vals, k, in_idx=idxs, select_min=True, sorted=sorted)
+# the running-buffer merge moved to the shared blocked-scan core as
+# fold_topk (same signature/semantics); alias retained for existing callers
+from ..ops.blocked_scan import fold_topk as tile_knn_merge  # noqa: E402
 
 
 @partial(jax.jit, static_argnames=("k", "metric", "tile"))
@@ -111,8 +101,7 @@ def _knn_impl(x, y, k: int, metric: str, tile: int,
 
     kk = min(k, tile)
 
-    def step(carry, inp):
-        best_val, best_idx = carry
+    def score(inp):
         t, yt, kt = inp
         dist = _tile_distances(x, yt, metric, xn)
         col = t * tile + jnp.arange(tile)
@@ -120,23 +109,18 @@ def _knn_impl(x, y, k: int, metric: str, tile: int,
         if keep is not None:
             valid = valid & (keep_t[t][None, :] if kt is None else kt)
         dist = jnp.where(valid, dist, jnp.inf)
+        # pre-cut each tile to kk before the fold: the top-k over
+        # (carry ∪ tile) equals top-k over (carry ∪ top-kk(tile)), and the
+        # fold then merges k+kk lanes instead of k+tile
         neg, loc = jax.lax.top_k(-dist, kk)
-        tv, ti = -neg, t * tile + loc
-        return tile_knn_merge(best_val, best_idx, tv, ti, k,
-                              sorted=False), None
+        return -neg, t * tile + loc
 
-    init = (
-        jnp.full((m, k), jnp.inf, jnp.float32),
-        jnp.zeros((m, k), jnp.int32),
-    )
-    (bv, bi), _ = jax.lax.scan(
-        step, init,
+    from ..ops.blocked_scan import scan_topk
+
+    bv, bi = scan_topk(
+        score,
         (jnp.arange(ytiles.shape[0], dtype=jnp.int32), ytiles, keep_xs),
-    )
-    # intermediate carries are unordered top-k sets; rank once at the end
-    from ..matrix.select_k import select_k
-
-    bv, bi = select_k(bv, k, in_idx=bi, select_min=True)
+        m, k, id_fill=0)
     if metric == "inner_product":
         bv = -bv  # undo the similarity negation
     return bv, bi
@@ -151,7 +135,7 @@ def _exact_candidate_distances(x, yc, metric: str, precision=None):
     is the first knob of the fast-path tuning tree (docs/perf_analysis.md)."""
     xf = x.astype(jnp.float32)
     ycf = yc.astype(jnp.float32)
-    from ._packing import exact_gathered_dots, int8_tier_eligible
+    from ..ops.blocked_scan import exact_gathered_dots, int8_tier_eligible
 
     if int8_tier_eligible(yc, x, x.shape[1]):
         # 8-bit pair: one bf16 pass is exact (see exact_gathered_dots)
@@ -220,7 +204,11 @@ def _fast_knn_impl(x, y, k: int, metric: str, cand: int, bm: int, bn: int,
         yn = jnp.where(row_keep, yn, jnp.inf)
 
     cand = min(cand, n)
-    if jax.default_backend() == "tpu":
+    from ..ops.pallas.gate import dispatch_mode
+
+    if dispatch_mode("fused_l2_topk") == "mosaic":
+        # validated TPU only: a stale MOSAIC_CHECK stamp or a wedged
+        # platform probe takes the XLA approx path below (gate logs why)
         from ..ops.pallas.fused_l2_topk import fused_shortlist
 
         sv, si = fused_shortlist(xs, ys, yn, bm=bm, bn=bn)

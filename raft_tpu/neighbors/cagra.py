@@ -275,7 +275,7 @@ def _nn_descent_round(x, graph, key, s: int, block: int):
     def score_block(args):
         xb, cb, gb = args
         vecs = x[jnp.maximum(cb, 0)]                         # [b, kk+s, d]
-        from ._packing import exact_gathered_dots
+        from ..ops.blocked_scan import exact_gathered_dots
 
         dots = exact_gathered_dots("bcd,bd->bc", vecs, xb)
         vn = jnp.sum(vecs.astype(jnp.float32) ** 2, axis=2)
@@ -461,7 +461,7 @@ def extend(index: CagraIndex, new_vectors,
 def _batch_dists(dataset, q, qn, ids, metric: str):
     """Exact query→candidate distances: [nq, L] for ids [nq, L]."""
     vecs = dataset[jnp.maximum(ids, 0)]  # [nq, L, d]
-    from ._packing import exact_gathered_dots
+    from ..ops.blocked_scan import exact_gathered_dots
 
     dots = exact_gathered_dots("qld,qd->ql", vecs, q)
     if metric == "inner_product":
@@ -505,12 +505,10 @@ def _expand_dists(dataset, q_score, qn, ids, metric: str):
     (w = 1) expansion produce identical values.  Folding w into the
     candidate dimension would retile the reduction and break
     frontier == per-parent bit parity."""
-    nq, w, _ = ids.shape
     vecs = dataset[jnp.maximum(ids, 0)]            # [nq, w, deg, d]
-    from ._packing import exact_gathered_dots
+    from ..ops.blocked_scan import slab_dots
 
-    qw = jnp.broadcast_to(q_score[:, None, :], (nq, w, q_score.shape[1]))
-    dots = exact_gathered_dots("qwcd,qwd->qwc", vecs, qw)
+    dots = slab_dots(vecs, q_score)
     if metric == "inner_product":
         return -dots
     vn = jnp.sum(vecs.astype(jnp.float32) ** 2, axis=3)
@@ -622,7 +620,7 @@ def _search_impl(dataset, graph, routers, router_nodes, q, key, iters_cap,
     # beam scoring takes the RAW query when the 8-bit single-pass tier
     # applies (the f32 cast would silently disable it); one shared
     # eligibility rule keeps this in lockstep with the scorer
-    from ._packing import int8_tier_eligible
+    from ..ops.blocked_scan import int8_tier_eligible
 
     q_score = q if int8_tier_eligible(dataset, q, d) else qf
     beam_val, beam_idx = _seed_beam(dataset, routers, router_nodes, q,
@@ -666,16 +664,11 @@ def _search_impl(dataset, graph, routers, router_nodes, q, key, iters_cap,
         nids = jnp.where(hit, -1, nids)
         # unsorted fold: exact top-itopk *set*, no ranking pass — ids and
         # explored flags ride the fold as payloads
-        cat_val = jnp.concatenate([beam_val, nvals], axis=1)
-        cpos = jnp.tile(
-            jnp.arange(cat_val.shape[1], dtype=jnp.int32)[None, :], (nq, 1))
-        mv, mpos = select_k(cat_val, itopk, in_idx=cpos, select_min=True,
-                            sorted=False)
-        mi = jnp.take_along_axis(
-            jnp.concatenate([bi0, nids], axis=1), mpos, axis=1)
-        mf = jnp.take_along_axis(
-            jnp.concatenate([explored2, jnp.zeros_like(hit)], axis=1),
-            mpos, axis=1)
+        from ..ops.blocked_scan import fold_topk_payload
+
+        mv, mi, (mf,) = fold_topk_payload(
+            beam_val, bi0, (explored2,), nvals, nids,
+            (jnp.zeros_like(hit),), itopk)
         mi = jnp.where(jnp.isfinite(mv), mi, -1)  # empty slots are id −1
         mf = mf | (mi < 0)
         # rebuild the ring: ONE int argsort over itopk lanes (ties only
@@ -716,7 +709,7 @@ def _search_impl_perop(dataset, graph, routers, router_nodes, q, key,
     deg = graph.shape[1]
     qf = q.astype(jnp.float32)
     qn = jnp.sum(qf * qf, axis=1)
-    from ._packing import int8_tier_eligible
+    from ..ops.blocked_scan import int8_tier_eligible
 
     q_score = q if int8_tier_eligible(dataset, q, d) else qf
     beam_val, beam_idx = _seed_beam(dataset, routers, router_nodes, q,

@@ -42,7 +42,6 @@ from ..core.array import wrap_array
 from ..core.compat import shard_map
 from ..core.errors import expects
 from ..distance.pairwise import sq_l2
-from .brute_force import tile_knn_merge
 
 __all__ = [
     "IvfFlatIndexParams",
@@ -80,6 +79,13 @@ class IvfFlatSearchParams:
     # Results are bit-identical for every value — this is a pure
     # latency/throughput knob (docs/tuning_guide.md).
     probe_block: int = 0
+    # blocked-scan engine: "auto" | "xla" | "fused".  "xla" is the
+    # bit-exact two-pass scan; "fused" runs the Pallas distance+partial
+    # top-k kernel per block with an exact re-score of the k finalists
+    # (recall-gated, not bit-pinned).  "auto" resolves through
+    # ops.blocked_scan.resolve_scan_kernel (Mosaic gate + tuned table) and
+    # is always "xla" off-TPU (docs/tuning_guide.md).
+    scan_kernel: str = "auto"
 
 
 @jax.tree_util.register_dataclass
@@ -377,45 +383,31 @@ def extend(index: IvfFlatIndex, new_vectors, new_ids=None, *,
 
 
 def _probe_scan(q, qn, data, ids, counts, norms, probes, k: int, metric: str,
-                keep=None, probe_block: int = 1):
-    """Scan probe *blocks*, merging each gathered block into the running
-    top-k.
+                keep=None, probe_block: int = 1, scan_kernel: str = "xla"):
+    """Scan probe *blocks* through the shared ``ops.blocked_scan`` core.
 
     q: [nq, d]; probes: [nq, P].  One iteration gathers the next B probed
-    lists of every query (one ``[nq, B·cap, d]`` slab), computes the
-    distance block with one batched MXU dot and folds it in with ONE
-    ``tile_knn_merge`` — ⌈P/B⌉ merges instead of P.  Per-candidate math is
-    independent of B, so results are bit-identical across block sizes; pad
-    probes (P not divisible by B) are masked to +inf, never duplicated.
-    Intermediate carries stay unordered (``sorted=False``); callers rank
-    once after the scan.  ``keep``: optional bool prefilter by source id.
-    """
-    from ._packing import blocked_probe_plan, exact_gathered_dots
+    lists of every query (one ``[nq, B·cap, d]`` slab), scores it with
+    ``slab_dots`` (B pinned in the einsum's batch dims — the bit-invariance
+    contract: results identical across block sizes) and folds it into the
+    running top-k — ⌈P/B⌉ merges instead of P.  Pad probes (P not
+    divisible by B) are masked to +inf, never duplicated.
+    ``keep``: optional bool prefilter by source id.  ``scan_kernel``:
+    ``"xla"`` (bit-exact two-pass) or ``"fused"`` (Pallas distance+partial
+    top-k in one kernel, exact re-score of the k finalists — recall-gated,
+    not bit-pinned)."""
+    from ..ops import blocked_scan as _scan
+    from ._packing import blocked_probe_plan
 
     nq = q.shape[0]
     cap = data.shape[1]
     lists_xs, pvalid = blocked_probe_plan(probes, probe_block)
 
-    def step(carry, inp):
-        best_val, best_idx = carry
+    def gather(inp):
         lists, pv = inp                           # [nq, B], [B]
-        B = lists.shape[1]
-        bcap = B * cap
+        bcap = lists.shape[1] * cap
         vecs = data[lists]                        # [nq, B, cap, d] gather
         vids = ids[lists].reshape(nq, bcap)       # [nq, B·cap]
-        # B stays in the einsum's *batch* dims: the inner [cap, d]·[d]
-        # contraction shape — hence f32 accumulation order — is then
-        # identical for every probe_block.  Folding B into the N dimension
-        # retiles the reduction and breaks blocked == per-probe bit parity.
-        dots = exact_gathered_dots(
-            "qbcd,qbd->qbc", vecs,
-            jnp.broadcast_to(q[:, None, :], (nq, B, q.shape[1])),
-        ).reshape(nq, bcap)
-        if metric == "inner_product":
-            dist = -dots
-        else:  # sqeuclidean / euclidean rank by squared L2
-            dist = norms[lists].reshape(nq, bcap) - 2.0 * dots + qn[:, None]
-            dist = jnp.maximum(dist, 0.0)
         valid = (jnp.arange(cap)[None, None, :]
                  < counts[lists][:, :, None]).reshape(nq, bcap)
         valid = valid & (vids >= 0) & jnp.repeat(pv, cap)[None, :]
@@ -423,29 +415,49 @@ def _probe_scan(q, qn, data, ids, counts, norms, probes, k: int, metric: str,
             from ._packing import keep_lookup
 
             valid = valid & keep_lookup(keep, vids)
-        dist = jnp.where(valid, dist, jnp.inf)
-        return tile_knn_merge(best_val, best_idx, dist, vids, k,
-                              sorted=False), None
+        return lists, vecs, vids, valid
 
-    init = (jnp.full((nq, k), jnp.inf, jnp.float32),
-            jnp.full((nq, k), -1, jnp.int32))
-    (bv, bi), _ = jax.lax.scan(step, init, (lists_xs, pvalid))
-    # one ranked selection over the unordered carry — the only sorted merge
-    from ..matrix.select_k import select_k
+    if scan_kernel == "fused":
+        def slab_step(inp):
+            lists, vecs, vids, valid = gather(inp)
+            bcap = vids.shape[1]
+            if metric == "inner_product":
+                base = jnp.zeros((nq, bcap), jnp.float32)
+            else:
+                base = norms[lists].reshape(nq, bcap)
+            return (vecs.reshape(nq, bcap, vecs.shape[-1]),
+                    jnp.where(valid, base, jnp.inf), vids,
+                    _scan.list_slab_ptr(lists, cap))
 
-    return select_k(bv, k, in_idx=bi, select_min=True)
+        rescore = _scan.l2_rescorer(data, norms, q, qn, metric)
+        return _scan.scan_topk_fused(q, slab_step, (lists_xs, pvalid),
+                                     rescore, nq, k)
+
+    def score(inp):
+        lists, vecs, vids, valid = gather(inp)
+        dots = _scan.slab_dots(vecs, q).reshape(nq, -1)
+        if metric == "inner_product":
+            dist = -dots
+        else:  # sqeuclidean / euclidean rank by squared L2
+            dist = norms[lists].reshape(nq, dots.shape[1]) - 2.0 * dots \
+                + qn[:, None]
+            dist = jnp.maximum(dist, 0.0)
+        return jnp.where(valid, dist, jnp.inf), vids
+
+    return _scan.scan_topk(score, (lists_xs, pvalid), nq, k)
 
 
-@partial(jax.jit, static_argnames=("k", "n_probes", "metric", "probe_block"))
+@partial(jax.jit, static_argnames=("k", "n_probes", "metric", "probe_block",
+                                   "scan_kernel"))
 def _search_impl(centroids, data, ids, counts, norms, q, k: int,
                  n_probes: int, metric: str, keep=None,
-                 probe_block: int = 1):
+                 probe_block: int = 1, scan_kernel: str = "xla"):
     qf = q.astype(jnp.float32)
     qn = jnp.sum(qf * qf, axis=1)
     cd = sq_l2(q, centroids)                      # [nq, L] MXU block
     _, probes = jax.lax.top_k(-cd, n_probes)      # nearest lists
     bv, bi = _probe_scan(q, qn, data, ids, counts, norms, probes, k, metric,
-                         keep, probe_block)
+                         keep, probe_block, scan_kernel)
     if metric == "euclidean":
         bv = jnp.sqrt(jnp.maximum(bv, 0.0))
     elif metric == "inner_product":
@@ -472,6 +484,10 @@ def search(index: IvfFlatIndex, queries, k: int,
     n_probes = min(p.n_probes, index.n_lists)
     probe_block = resolve_probe_block(p.probe_block, int(n_probes),
                                       index.list_cap, "ivf_flat")
+    from ..ops.blocked_scan import resolve_scan_kernel
+
+    scan_kernel = resolve_scan_kernel(p.scan_kernel, "ivf_flat",
+                                      probe_block * index.list_cap, int(k))
     keep = as_keep_mask(filter, nq=q.shape[0])  # indexes source ids
     if keep is not None:
         check_filter_covers_ids(keep, index.ids)
@@ -479,7 +495,7 @@ def search(index: IvfFlatIndex, queries, k: int,
     impl = lambda qc, kc: _search_impl(
         index.centroids, index.data, index.ids, index.counts,
         index.norms, qc, int(k), int(n_probes), index.metric, kc,
-        probe_block)
+        probe_block, scan_kernel)
     dv, di = chunked_filtered_queries(impl, q, int(p.query_chunk), keep)
     if keep is not None:  # sub-k survivors: sentinel tail, not real ids
         di = sentinel_filtered_ids(dv, di)
@@ -510,6 +526,10 @@ def searcher(index: IvfFlatIndex, k: int,
     n_probes = int(min(p.n_probes, index.n_lists))
     probe_block = resolve_probe_block(p.probe_block, n_probes,
                                       index.list_cap, "ivf_flat")
+    from ..ops.blocked_scan import resolve_scan_kernel
+
+    scan_kernel = resolve_scan_kernel(p.scan_kernel, "ivf_flat",
+                                      probe_block * index.list_cap, int(k))
     metric = index.metric
     keep = as_keep_mask(filter)
     if keep is not None:
@@ -520,7 +540,8 @@ def searcher(index: IvfFlatIndex, k: int,
 
         def fn(q, centroids, data, ids, counts, norms, kp):
             dv, di = _search_impl(centroids, data, ids, counts, norms, q,
-                                  int(k), n_probes, metric, kp, probe_block)
+                                  int(k), n_probes, metric, kp, probe_block,
+                                  scan_kernel)
             return dv, sentinel_filtered_ids(dv, di)
 
         return fn, (index.centroids, index.data, index.ids, index.counts,
@@ -528,7 +549,8 @@ def searcher(index: IvfFlatIndex, k: int,
 
     def fn(q, centroids, data, ids, counts, norms):
         return _search_impl(centroids, data, ids, counts, norms, q,
-                            int(k), n_probes, metric, None, probe_block)
+                            int(k), n_probes, metric, None, probe_block,
+                            scan_kernel)
 
     return fn, (index.centroids, index.data, index.ids, index.counts,
                 index.norms)

@@ -57,7 +57,6 @@ from ..core.compat import shard_map
 from ..core.errors import expects
 from ..distance.pairwise import sq_l2
 from ._packing import chunked_filtered_queries, pack_lists
-from .brute_force import tile_knn_merge
 
 __all__ = [
     "IvfPqIndexParams",
@@ -103,6 +102,10 @@ class IvfPqSearchParams:
     # table via bench/tune_probe_block.py, else a working-set heuristic).
     # Bit-identical results at every value — a pure speed knob.
     probe_block: int = 0
+    # recon-tier scan kernel: "auto" | "xla" | "fused" — same contract as
+    # IvfFlatSearchParams.scan_kernel.  The LUT tier has no distance
+    # einsum to fuse and always runs the XLA scan.
+    scan_kernel: str = "auto"
 
 
 @jax.tree_util.register_dataclass
@@ -683,10 +686,12 @@ def _build_chunked_perop(dataset, params: Optional[IvfPqIndexParams] = None,
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnames=("k", "n_probes", "metric", "probe_block"))
+@partial(jax.jit, static_argnames=("k", "n_probes", "metric", "probe_block",
+                                   "scan_kernel"))
 def _search_recon_impl(centroids, recon, recon_norms, ids, q,
                        k: int, n_probes: int, metric: str, keep=None,
-                       probe_block: int = 1):
+                       probe_block: int = 1, scan_kernel: str = "xla"):
+    from ..ops import blocked_scan as _scan
     from ._packing import blocked_probe_plan
 
     nq, d = q.shape
@@ -698,43 +703,56 @@ def _search_recon_impl(centroids, recon, recon_norms, ids, q,
     _, probes = jax.lax.top_k(-cd, n_probes)
     lists_xs, pvalid = blocked_probe_plan(probes, probe_block)
 
-    def step(carry, inp):
-        best_val, best_idx = carry
+    def gather(inp):
         lists, pv = inp                           # [nq, B], [B]
-        B = lists.shape[1]
-        bcap = B * cap
+        bcap = lists.shape[1] * cap
         slab = recon[lists]                       # one [nq, B, cap, d] gather
         vids = ids[lists].reshape(nq, bcap)
-        # keep B in the *batch* dims so the inner [cap, d]·[d] contraction
-        # — and with it the f32 accumulation order — is identical for every
-        # probe_block.  Folding B into the N dimension ("q(bc)d,qd") retiles
-        # the reduction and shifts last-ulp rounding, breaking the
-        # blocked == per-probe bit-parity contract.
-        dots = jnp.einsum(
-            "qbcd,qbd->qbc", slab,
-            jnp.broadcast_to(qb[:, None, :], (nq, B, d)),
-            preferred_element_type=jnp.float32).reshape(nq, bcap)
-        if metric == "inner_product":
-            dist = jnp.where(vids >= 0, -dots, jnp.inf)
-        else:
-            # recon_norms carries +inf on pad entries — they self-mask
-            dist = qn[:, None] - 2.0 * dots + recon_norms[lists].reshape(
-                nq, bcap)
+        return lists, pv, slab, vids
+
+    def mask(dist, lists, pv, vids):
         # pad probes (n_probes % B != 0) contribute nothing
         dist = jnp.where(jnp.repeat(pv, cap)[None, :], dist, jnp.inf)
         if keep is not None:  # prefilter by source id (True = keep)
             from ._packing import keep_lookup
 
             dist = jnp.where(keep_lookup(keep, vids), dist, jnp.inf)
-        return tile_knn_merge(best_val, best_idx, dist, vids, k,
-                              sorted=False), None
+        return dist
 
-    init = (jnp.full((nq, k), jnp.inf, jnp.float32),
-            jnp.full((nq, k), -1, jnp.int32))
-    (bv, bi), _ = jax.lax.scan(step, init, (lists_xs, pvalid))
-    from ..matrix.select_k import select_k
+    if scan_kernel == "fused":
+        def slab_step(inp):
+            lists, pv, slab, vids = gather(inp)
+            bcap = vids.shape[1]
+            if metric == "inner_product":
+                base = jnp.where(vids >= 0, 0.0, jnp.inf)
+            else:
+                # recon_norms carries +inf on pad entries — they self-mask
+                base = recon_norms[lists].reshape(nq, bcap)
+            return (slab.reshape(nq, bcap, d), mask(base, lists, pv, vids),
+                    vids, _scan.list_slab_ptr(lists, cap))
 
-    bv, bi = select_k(bv, k, in_idx=bi, select_min=True)
+        rescore = _scan.l2_rescorer(recon, recon_norms, qb, qn, metric,
+                                    exact=False, clamp=False)
+        bv, bi = _scan.scan_topk_fused(qb, slab_step, (lists_xs, pvalid),
+                                       rescore, nq, k)
+    else:
+        def score(inp):
+            lists, pv, slab, vids = gather(inp)
+            # B stays in slab_dots' *batch* dims so the inner [cap, d]·[d]
+            # contraction — and with it the f32 accumulation order — is
+            # identical for every probe_block (the bit-parity contract);
+            # exact=False keeps the recon tier's single bf16 MXU pass.
+            dots = _scan.slab_dots(slab, qb, exact=False).reshape(
+                nq, vids.shape[1])
+            if metric == "inner_product":
+                dist = jnp.where(vids >= 0, -dots, jnp.inf)
+            else:
+                # recon_norms carries +inf on pad entries — they self-mask
+                dist = qn[:, None] - 2.0 * dots + recon_norms[lists].reshape(
+                    nq, dots.shape[1])
+            return mask(dist, lists, pv, vids), vids
+
+        bv, bi = _scan.scan_topk(score, (lists_xs, pvalid), nq, k)
     if metric == "euclidean":
         bv = jnp.sqrt(jnp.maximum(bv, 0.0))
     elif metric == "inner_product":
@@ -776,8 +794,7 @@ def _search_lut_impl(centroids, codebooks, codes, adc_norms, ids, counts, q,
         qc = qf @ centroids.T                     # [nq, L] ⟨q, c⟩, hoisted
     lists_xs, pvalid = blocked_probe_plan(probes, probe_block)
 
-    def step(carry, inp):
-        best_val, best_idx = carry
+    def score(inp):
         lists, pv = inp                           # [nq, B], [B]
         B = lists.shape[1]
         bcap = B * cap
@@ -808,16 +825,11 @@ def _search_lut_impl(centroids, codebooks, codes, adc_norms, ids, counts, q,
             from ._packing import keep_lookup
 
             valid = valid & keep_lookup(keep, vids)
-        dist = jnp.where(valid, dist, jnp.inf)
-        return tile_knn_merge(best_val, best_idx, dist, vids, k,
-                              sorted=False), None
+        return jnp.where(valid, dist, jnp.inf), vids
 
-    init = (jnp.full((nq, k), jnp.inf, jnp.float32),
-            jnp.full((nq, k), -1, jnp.int32))
-    (bv, bi), _ = jax.lax.scan(step, init, (lists_xs, pvalid))
-    from ..matrix.select_k import select_k
+    from ..ops.blocked_scan import scan_topk
 
-    bv, bi = select_k(bv, k, in_idx=bi, select_min=True)
+    bv, bi = scan_topk(score, (lists_xs, pvalid), nq, k)
     if metric == "euclidean":
         bv = jnp.sqrt(jnp.maximum(bv, 0.0))
     elif metric == "inner_product":
@@ -854,9 +866,15 @@ def search(index: IvfPqIndex, queries, k: int,
         expects(index.recon is not None,
                 "mode='recon' needs the reconstruction slab — call "
                 "index.with_recon() (e.g. after load_index)")
+        from ..ops.blocked_scan import resolve_scan_kernel
+
+        scan_kernel = resolve_scan_kernel(p.scan_kernel, "ivf_pq",
+                                          probe_block * index.list_cap,
+                                          int(k))
         impl = lambda qc, kc: _search_recon_impl(
             index.centroids, index.recon, index.recon_norms, index.ids,
-            qc, int(k), int(n_probes), index.metric, kc, probe_block)
+            qc, int(k), int(n_probes), index.metric, kc, probe_block,
+            scan_kernel)
     else:
         # legacy/hand-built indexes without the hoisted-ADC tables:
         # derive them here (per call — materialize with with_adc_luts()
@@ -909,12 +927,18 @@ def searcher(index: IvfPqIndex, k: int,
         expects(index.recon is not None,
                 "mode='recon' needs the reconstruction slab — call "
                 "index.with_recon() (e.g. after load_index)")
+        from ..ops.blocked_scan import resolve_scan_kernel
+
+        scan_kernel = resolve_scan_kernel(p.scan_kernel, "ivf_pq",
+                                          probe_block * index.list_cap,
+                                          int(k))
         if keep is not None:
 
             def fn(q, centroids, recon, recon_norms, ids, kp):
                 dv, di = _search_recon_impl(centroids, recon, recon_norms,
                                             ids, q, int(k), n_probes,
-                                            metric, kp, probe_block)
+                                            metric, kp, probe_block,
+                                            scan_kernel)
                 return dv, sentinel_filtered_ids(dv, di)
 
             return fn, (index.centroids, index.recon, index.recon_norms,
@@ -923,7 +947,7 @@ def searcher(index: IvfPqIndex, k: int,
         def fn(q, centroids, recon, recon_norms, ids):
             return _search_recon_impl(centroids, recon, recon_norms, ids,
                                       q, int(k), n_probes, metric, None,
-                                      probe_block)
+                                      probe_block, scan_kernel)
 
         return fn, (index.centroids, index.recon, index.recon_norms,
                     index.ids)

@@ -1,2 +1,8 @@
 """raft_tpu.ops — kernel-level implementations (Pallas + XLA formulations)
-backing the public primitives.  Analog of the reference's ``detail/`` layer."""
+backing the public primitives.  Analog of the reference's ``detail/`` layer.
+
+``ops.blocked_scan`` is the shared blocked-scan core every neighbors
+engine routes through (slab scoring einsum, ``select_k(sorted=False)``
+fold, fused-kernel dispatch); ``ops.pallas`` holds the Mosaic kernels and
+their hardware gate.  Submodules import lazily — ``import raft_tpu.ops``
+alone must not initialize a backend."""

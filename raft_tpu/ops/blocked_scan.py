@@ -1,0 +1,332 @@
+"""One blocked-scan core for every neighbors engine.
+
+The probe-blocked IVF engines (PR 3), the frontier-blocked CAGRA engine
+(PR 5), and the tiled brute-force scan all share one shape:
+
+    slab gather → batch-dim distance einsum → select_k(sorted=False) fold
+    per block → ONE ranked selection at exit
+
+but until this module each engine carried its own copy of the fold/carry
+boilerplate, so there was no single place to land a fused kernel.  This
+module owns the contract:
+
+* :func:`slab_dots` — the batch-dim scoring einsum with the **pinned
+  per-candidate accumulation shape**: the block axis stays a *batch*
+  dimension (``"qbcd,qbd->qbc"``), so the inner ``[cap, d]·[d]`` f32
+  accumulation order is identical for every block size.  Folding the
+  block axis into the candidate axis would retile the reduction and break
+  the PR 3/5 bit-invariance contract (blocked results bit-identical to
+  the per-item reference engines for ANY block size).
+* :func:`fold_topk` / :func:`fold_topk_payload` — the
+  ``select_k(sorted=False)`` fold, without and with payload lanes
+  (CAGRA's explored flags, the fused path's slab pointers).
+* :func:`scan_topk` — the ``scan(carry, slab) -> carry`` driver: carry
+  init, per-block fold, ranked exit selection.
+* :func:`scan_topk_fused` — the same contract with the distance tile and
+  an approximate partial top-k fused into ONE Pallas kernel
+  (``ops/pallas/fused_scan.py``, TPU-KNN's PartialReduce scheme), plus an
+  exact re-score of the k finalists so reported distances stay f32-exact.
+  Approximate-partial: the candidate *set* is recall-gated, not
+  bit-pinned (a true neighbor is shed only on a ≥3-way lane-bucket
+  collision within one slab block).
+
+:func:`exact_gathered_dots` and :func:`int8_tier_eligible` moved here
+from ``neighbors/_packing.py`` (which re-exports them): the scoring-tier
+rule is owned by the scan core, and ``ops`` must not import from
+``neighbors``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from functools import lru_cache
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["int8_tier_eligible", "exact_gathered_dots", "slab_dots",
+           "fold_topk", "fold_topk_payload", "topk_carry", "ranked_finish",
+           "scan_topk", "scan_topk_fused", "list_slab_ptr", "l2_rescorer",
+           "resolve_scan_kernel", "scan_kernel_sha"]
+
+
+def int8_tier_eligible(a, b, d: int) -> bool:
+    """True when the single-pass bf16 scoring tier is EXACT for a·b dots
+    over contraction length ``d`` — the ONE home of the eligibility rule
+    (every call site must agree or a raw integer query silently reverts a
+    path to the 6× slower HIGHEST einsum).
+
+    Exactness needs every f32 partial sum to stay an exact integer
+    (< 2²⁴): uint8 products reach 255² ⇒ d ≤ 256; int8 reach 128² ⇒
+    d ≤ 1024.  Beyond the bound integer dot gaps of 1 could round away —
+    HIGHEST was exact there, so the tier must not regress it."""
+    kinds = (jnp.uint8, jnp.int8)
+    if a.dtype not in kinds or b.dtype not in kinds:
+        return False
+    lim = 256 if jnp.uint8 in (a.dtype, b.dtype) else 1024
+    return d <= lim
+
+
+def exact_gathered_dots(subscripts: str, vecs, q):
+    """Query·candidate dots for gathered rows — the shared scoring einsum
+    of the IVF-Flat probe scan, the CAGRA beam step, and the brute-force
+    exact/refine paths.
+
+    Eligible 8-bit corpora (:func:`int8_tier_eligible`) take ONE bf16 MXU
+    pass: the values are bf16-exact and the MXU accumulates products in
+    f32, so the result matches the f32 path exactly at ~6× the MXU rate of
+    ``Precision.HIGHEST``.  Everything else keeps the bf16x6 HIGHEST
+    passes — a single pass would genuinely lose ranking precision there."""
+    if int8_tier_eligible(vecs, q, int(vecs.shape[-1])):
+        return jnp.einsum(subscripts, vecs.astype(jnp.bfloat16),
+                          q.astype(jnp.bfloat16),
+                          preferred_element_type=jnp.float32)
+    return jnp.einsum(subscripts, vecs, q,
+                      preferred_element_type=jnp.float32,
+                      precision=jax.lax.Precision.HIGHEST)
+
+
+def slab_dots(vecs, q, *, exact: bool = True):
+    """Score one gathered slab: ``[nq, B, C, d] · [nq, d] → [nq, B, C]``.
+
+    This is THE blocked-scan distance einsum — the single insertion point
+    every engine routes through — with the block axis ``B`` pinned as a
+    batch dimension (bit-invariance across block sizes, see module doc).
+
+    ``exact=True`` (IVF-Flat, CAGRA, brute-force refine) dispatches via
+    :func:`exact_gathered_dots`; ``exact=False`` is the IVF-PQ recon
+    tier's contract — ONE bf16 MXU pass with f32 accumulation over
+    already-lossy reconstructions, where HIGHEST would triple the cost for
+    precision the codes don't carry."""
+    nq, b = vecs.shape[0], vecs.shape[1]
+    qb = jnp.broadcast_to(q[:, None, :], (nq, b, q.shape[-1]))
+    if exact:
+        return exact_gathered_dots("qbcd,qbd->qbc", vecs, qb)
+    return jnp.einsum("qbcd,qbd->qbc", vecs, qb,
+                      preferred_element_type=jnp.float32)
+
+
+def fold_topk(best_val, best_idx, tile_val, tile_idx, k: int, *,
+              sorted: bool = True):
+    """Merge a new candidate block into the running (m, k) best buffers via
+    ``matrix.select_k`` — one selection primitive owns all top-k tuning.
+
+    ``sorted=False`` keeps the carry an unordered top-k set (exact values
+    and ids, unspecified row order) — the right form for intermediate scan
+    carries, where only the FINAL merge needs ranked output."""
+    from ..matrix.select_k import select_k
+
+    vals = jnp.concatenate([best_val, tile_val], axis=1)
+    idxs = jnp.concatenate([best_idx, tile_idx], axis=1)
+    return select_k(vals, k, in_idx=idxs, select_min=True, sorted=sorted)
+
+
+def fold_topk_payload(best_val, best_idx, best_payload: Sequence,
+                      tile_val, tile_idx, tile_payload: Sequence, k: int):
+    """:func:`fold_topk` with payload lanes riding the selection (CAGRA's
+    explored flags, the fused path's slab pointers, build's counts).
+
+    Selects by *concat position*, then gathers ids and every payload lane
+    through the winning positions — bit-identical to the direct
+    ``in_idx=ids`` fold (``select_k`` picks positions internally either
+    way), which is what lets the payload-free engines share the same
+    selection primitive.  Unsorted carry form (``sorted=False``)."""
+    from ..matrix.select_k import select_k
+
+    cat_val = jnp.concatenate([best_val, tile_val], axis=1)
+    cat_idx = jnp.concatenate([best_idx, tile_idx], axis=1)
+    cpos = jnp.tile(jnp.arange(cat_val.shape[1], dtype=jnp.int32)[None, :],
+                    (cat_val.shape[0], 1))
+    mv, mpos = select_k(cat_val, k, in_idx=cpos, select_min=True,
+                        sorted=False)
+    mi = jnp.take_along_axis(cat_idx, mpos, axis=1)
+    out = tuple(
+        jnp.take_along_axis(jnp.concatenate([bp, tp], axis=1), mpos, axis=1)
+        for bp, tp in zip(best_payload, tile_payload))
+    return mv, mi, out
+
+
+def topk_carry(nq: int, k: int, *, id_fill: int = -1):
+    """Fresh (values, ids) scan carry: +inf distances, ``id_fill`` ids
+    (brute-force historically fills 0, the IVF engines −1 — preserved so
+    the refactor stays bit-identical in the ids of sub-k result rows)."""
+    return (jnp.full((nq, k), jnp.inf, jnp.float32),
+            jnp.full((nq, k), id_fill, jnp.int32))
+
+
+def ranked_finish(vals, ids, k: int):
+    """The ONE ranked selection at scan exit: intermediate carries are
+    unordered top-k sets; rank once here."""
+    from ..matrix.select_k import select_k
+
+    return select_k(vals, k, in_idx=ids, select_min=True)
+
+
+def scan_topk(score_step: Callable, xs, nq: int, k: int, *,
+              id_fill: int = -1) -> Tuple[jax.Array, jax.Array]:
+    """The shared blocked-scan driver (XLA path).
+
+    ``score_step(slab_inputs) -> (dist [nq, L], ids [nq, L])`` owns the
+    engine-specific slab gather + scoring + validity masking (invalid
+    lanes must carry ``+inf``); this driver owns the carry init, the
+    per-block :func:`fold_topk` (unsorted), and the ranked exit — the
+    ``scan(carry, slab) -> carry`` contract in one place."""
+
+    def step(carry, inp):
+        bv, bi = carry
+        dist, ids = score_step(inp)
+        return fold_topk(bv, bi, dist, ids, k, sorted=False), None
+
+    (bv, bi), _ = jax.lax.scan(step, topk_carry(nq, k, id_fill=id_fill), xs)
+    return ranked_finish(bv, bi, k)
+
+
+def scan_topk_fused(q, slab_step: Callable, xs, rescore: Callable,
+                    nq: int, k: int, *, shortlist_block: int = 512,
+                    interpret: Optional[bool] = None
+                    ) -> Tuple[jax.Array, jax.Array]:
+    """Fused-kernel blocked scan: each block's distance tile and an
+    approximate partial top-k run INSIDE one Pallas kernel
+    (:func:`raft_tpu.ops.pallas.fused_scan.fused_slab_topk`), so the
+    ``[nq, L]`` distance block never materializes in HBM.
+
+    ``slab_step(slab_inputs) -> (vecs [nq, C, d], base [nq, C],
+    vids [nq, C], ptr [nq, C])`` gathers the slab and computes the
+    surrogate base (``‖y‖²``-like per-candidate offset; invalid lanes
+    ``+inf``); the kernel scores ``base − 2·⟨q, vec⟩``.  ``ptr`` is an
+    engine-defined storage pointer payload lane carried through the fold
+    so ``rescore(ptr [nq, k], vids [nq, k]) -> dist [nq, k]`` can re-gather
+    the k finalists and re-score them exactly — reported values match the
+    engine's exact metric; only the candidate *set* is approximate
+    (recall-gated, not bit-pinned)."""
+
+    def step(carry, inp):
+        from .pallas.fused_scan import fused_slab_topk
+
+        bv, bi, bp = carry
+        vecs, base, vids, ptr = slab_step(inp)
+        sv, spos = fused_slab_topk(vecs, base, q, bn=shortlist_block,
+                                   interpret=interpret)
+        svids = jnp.take_along_axis(vids, spos, axis=1)
+        sptr = jnp.take_along_axis(ptr, spos, axis=1)
+        mv, mi, (mp,) = fold_topk_payload(bv, bi, (bp,), sv, svids, (sptr,), k)
+        return (mv, mi, mp), None
+
+    bv0, bi0 = topk_carry(nq, k)
+    bp0 = jnp.zeros((nq, k), jnp.int32)
+    (bv, bi, bp), _ = jax.lax.scan(step, (bv0, bi0, bp0), xs)
+    dist = rescore(bp, bi)
+    dist = jnp.where(jnp.isfinite(bv) & (bi >= 0), dist, jnp.inf)
+    return ranked_finish(dist, bi, k)
+
+
+def list_slab_ptr(lists, cap: int):
+    """Storage pointers for a gathered ``[nq, B]`` list block over a
+    ``[L, cap, …]`` slab: flat row ``list·cap + slot``, shaped
+    ``[nq, B·cap]`` to match the block's candidate lanes — the payload
+    lane :func:`scan_topk_fused` carries so ``rescore`` can re-gather
+    finalists from the flattened slab."""
+    nq, b = lists.shape
+    slot = jnp.arange(cap, dtype=jnp.int32)
+    return (lists[:, :, None].astype(jnp.int32) * cap
+            + slot[None, None, :]).reshape(nq, b * cap)
+
+
+def l2_rescorer(data, norms, q, qn, metric: str, *, exact: bool = True,
+                clamp: bool = True) -> Callable:
+    """Build the ``rescore(ptr, vids)`` closure for an IVF-style fused
+    scan: re-gather the k finalist rows from the flattened ``[L·cap, d]``
+    slab and re-score them with the engine's exact metric algebra
+    (``exact=True`` → :func:`exact_gathered_dots` tiering; ``exact=False``
+    → the recon tier's single bf16 MXU pass).  ``clamp`` matches each
+    engine's squared-L2 floor convention (IVF-Flat clamps at 0, the recon
+    tier does not)."""
+    flat_data = data.reshape(-1, data.shape[-1])
+    flat_norms = norms.reshape(-1)
+
+    def rescore(ptr, _vids):
+        rows = flat_data[ptr]                     # [nq, k, d] finalists
+        if exact:
+            dots = exact_gathered_dots("qkd,qd->qk", rows, q)
+        else:
+            dots = jnp.einsum("qkd,qd->qk", rows, q,
+                              preferred_element_type=jnp.float32)
+        if metric == "inner_product":
+            return -dots
+        dist = flat_norms[ptr] - 2.0 * dots + qn[:, None]
+        return jnp.maximum(dist, 0.0) if clamp else dist
+
+    return rescore
+
+
+def scan_kernel_sha() -> str:
+    """Hash of the fused-path sources — scopes the tuned scan-kernel table
+    (``bench/tune_select_k.py`` writes it, :func:`resolve_scan_kernel`
+    rejects a table whose sha no longer matches the kernels it measured)."""
+    import hashlib
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    h = hashlib.sha256()
+    for rel in ("blocked_scan.py", os.path.join("pallas", "fused_scan.py"),
+                os.path.join("pallas", "gate.py")):
+        with open(os.path.join(root, rel), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+@lru_cache(maxsize=1)
+def _scan_kernel_table():
+    """Measured xla-vs-fused table written by the ``bench/tune_select_k.py``
+    fused arm.  Canonical name first; a ``.{backend}.json`` suffix holds
+    off-TPU measurements.  A table whose ``kernel_sha`` doesn't match the
+    current fused-path sources is stale and ignored."""
+    base = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "_scan_kernel_table.json")
+    cands = [base]
+    try:
+        cands.append(base.replace(".json", f".{jax.default_backend()}.json"))
+    except Exception:  # pragma: no cover - backend probe failure
+        pass
+    for path in cands:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if doc.get("kernel_sha") != scan_kernel_sha():
+            from ..core.logging import default_logger
+
+            default_logger().info(
+                "scan-kernel table %s is sha-stale (table %s, sources %s); "
+                "auto keeps the XLA path", os.path.basename(path),
+                doc.get("kernel_sha"), scan_kernel_sha())
+            continue
+        return doc.get("entries", {})
+    return {}
+
+
+def resolve_scan_kernel(requested: str, family: str, n_candidates: int,
+                        k: int) -> str:
+    """Resolve the engine ``scan_kernel`` knob to ``"xla"`` or ``"fused"``.
+
+    ``"auto"`` picks fused only when the Mosaic hardware gate is open
+    (validated ``bench/MOSAIC_CHECK.json``, see ``ops/pallas/gate.py``)
+    AND the sha-scoped tuned table says fused wins for this
+    ``family : candidates-per-block : k`` bucket — off-TPU auto therefore
+    always resolves to the XLA path (interpret-mode Pallas is a parity
+    tool, not a fast path)."""
+    from ..core.errors import expects
+
+    expects(requested in ("auto", "xla", "fused"),
+            f"scan_kernel must be auto|xla|fused, got {requested!r}")
+    if requested != "auto":
+        return requested
+    from .pallas.gate import mosaic_gate
+
+    ok, _ = mosaic_gate("fused_scan")
+    if not ok:
+        return "xla"
+    key = f"{family}:{int(n_candidates).bit_length()}:{int(k).bit_length()}"
+    return _scan_kernel_table().get(key, "xla")

@@ -6,11 +6,17 @@ tiled contraction engine ``linalg/detail/contractions.cuh``): where XLA's
 stock lowering leaves performance on the table, the op is expressed as an
 explicit grid over VMEM-resident blocks.
 
-Kernels fall back to ``interpret=True`` off-TPU so the same code paths are
-exercised by the CPU test mesh (SURVEY.md §4's LocalCUDACluster analog).
+Dispatch is centralized in :mod:`.gate`: Mosaic only behind a validated
+``bench/MOSAIC_CHECK.json`` hardware stamp, ``interpret=True`` off-TPU so
+the same code paths are exercised by the CPU test mesh (SURVEY.md §4's
+LocalCUDACluster analog), and logged stock-XLA fallbacks when the stamp
+is stale or the platform probe wedges.
 """
 
+from .gate import dispatch_mode, mosaic_gate, pallas_kernel_sha, reset_gate
 from .select_k import select_k_pallas
 from .fused_l2_topk import fused_shortlist
+from .fused_scan import fused_slab_topk
 
-__all__ = ["select_k_pallas", "fused_shortlist"]
+__all__ = ["select_k_pallas", "fused_shortlist", "fused_slab_topk",
+           "dispatch_mode", "mosaic_gate", "pallas_kernel_sha", "reset_gate"]

@@ -163,8 +163,28 @@ def fused_shortlist(
     if x.dtype != jnp.int8:
         x = x.astype(jnp.bfloat16)
         y = y.astype(jnp.bfloat16)
-    interpret = jax.default_backend() != "tpu"
-    return _call(x, y, yn.reshape(1, -1).astype(jnp.float32), bm, bn, interpret)
+    yn = yn.reshape(1, -1).astype(jnp.float32)
+    # Mosaic gate (see gate.py): stale hardware stamp / wedged probe on a
+    # TPU host → same shortlist contract from stock XLA ops, reason logged
+    from .gate import dispatch_mode
+
+    mode = dispatch_mode("fused_l2_topk")
+    if mode == "xla":
+        if x.dtype == jnp.int8:
+            dots = jnp.matmul(x.astype(jnp.int32), y.T.astype(jnp.int32)
+                              ).astype(jnp.float32)
+        else:
+            dots = jnp.matmul(x, y.T, preferred_element_type=jnp.float32)
+        dist = yn - 2.0 * dots
+        width = min(2 * bn, dist.shape[1])
+        neg, idx = jax.lax.top_k(-dist, width)
+        pad = 2 * bn - width
+        if pad:
+            neg = jnp.pad(neg, ((0, 0), (0, pad)),
+                          constant_values=-jnp.inf)
+            idx = jnp.pad(idx, ((0, 0), (0, pad)))
+        return -neg, idx
+    return _call(x, y, yn, bm, bn, mode != "mosaic")
 
 
 def center_int8(a: jax.Array) -> jax.Array:

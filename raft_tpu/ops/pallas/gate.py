@@ -1,0 +1,179 @@
+"""Hardware dispatch gate shared by every Pallas kernel.
+
+Mosaic lowering is only trusted after ``scripts/mosaic_check.py`` has
+validated the kernels on the actual hardware and stamped
+``bench/MOSAIC_CHECK.json``.  Before this module, each kernel decided
+dispatch with a bare ``jax.default_backend() != "tpu"`` and callers were
+expected to pre-check the artifact — which fails exactly in the live
+failure mode (BENCH_r04/r05): a wedged TPU tunnel where the platform
+probe hangs, or a stale artifact from an older kernel source tree.
+
+The gate centralizes three decisions, each with a *logged reason* so a
+fallback is observable instead of silent:
+
+* :func:`probe_backend` — ``jax.default_backend()`` behind a daemon-thread
+  timeout (``RAFT_PLATFORM_PROBE_TIMEOUT`` seconds, default 60).  A wedged
+  probe returns ``None`` instead of hanging the dispatch site.
+* :func:`mosaic_gate` — is the hardware stamp trustworthy?  Requires a
+  readable artifact with ``ok: true``, ``backend: "tpu"``, and a
+  ``kernel_sha`` matching the current kernel sources
+  (:func:`pallas_kernel_sha`); anything else is *stale*.
+* :func:`dispatch_mode` — the per-call-site resolution:
+  ``"mosaic"`` (compile for real), ``"interpret"`` (off-TPU parity mode,
+  the CPU test mesh), or ``"xla"`` (clean fallback: on-TPU but the gate is
+  closed or the probe wedged — kernels must take their stock-XLA path).
+
+``RAFT_MOSAIC_GATE=off`` bypasses the artifact check (backend probe still
+decides mosaic-vs-interpret) — ``scripts/mosaic_check.py`` sets it so the
+validation run itself can exercise Mosaic before the artifact exists.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Optional, Tuple
+
+__all__ = ["probe_backend", "mosaic_gate", "dispatch_mode",
+           "pallas_kernel_sha", "reset_gate"]
+
+_ARTIFACT = os.path.normpath(os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "..", "..", "..", "bench", "MOSAIC_CHECK.json"))
+
+_lock = threading.Lock()
+_cache: dict = {}
+_logged: set = set()
+
+
+def reset_gate() -> None:
+    """Drop every memoized decision (tests; after re-running the checker)."""
+    with _lock:
+        _cache.clear()
+        _logged.clear()
+
+
+def _log_once(key: str, msg: str, *args) -> None:
+    with _lock:
+        if key in _logged:
+            return
+        _logged.add(key)
+    from ...core.logging import default_logger
+
+    default_logger().warning(msg, *args)
+
+
+def probe_backend(timeout_s: Optional[float] = None) -> Optional[str]:
+    """``jax.default_backend()`` that cannot wedge the caller.
+
+    The first call runs the probe on a daemon thread and joins with a
+    timeout; ``None`` means the probe hung or raised (the BENCH_r04/r05
+    tunnel wedge) and the process should stay off the device-initializing
+    paths.  The verdict — including ``None`` — is memoized: retrying a
+    wedged probe at every dispatch would stack up doomed threads."""
+    with _lock:
+        if "backend" in _cache:
+            return _cache["backend"]
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("RAFT_PLATFORM_PROBE_TIMEOUT", "60"))
+    result: dict = {}
+
+    def work():
+        try:
+            import jax
+
+            result["backend"] = jax.default_backend()
+        except Exception as e:  # pragma: no cover - init failure path
+            result["error"] = repr(e)
+
+    t = threading.Thread(target=work, daemon=True,
+                         name="raft-tpu-platform-probe")
+    t.start()
+    t.join(timeout_s)
+    backend = result.get("backend")
+    if backend is None:
+        _log_once("probe", "platform probe %s after %.0fs — treating the "
+                  "backend as unavailable; Pallas dispatch falls back to "
+                  "stock XLA paths",
+                  "raised " + result["error"] if "error" in result
+                  else "did not return", timeout_s)
+    with _lock:
+        _cache["backend"] = backend
+    return backend
+
+
+def pallas_kernel_sha() -> str:
+    """Hash of the kernel sources the hardware stamp vouches for — an
+    artifact whose sha differs was validated against different code and
+    counts as stale."""
+    import hashlib
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    h = hashlib.sha256()
+    for rel in ("select_k.py", "fused_l2_topk.py", "fused_scan.py",
+                os.path.join("..", "bin_select.py")):
+        try:
+            with open(os.path.join(here, rel), "rb") as f:
+                h.update(f.read())
+        except OSError:
+            h.update(b"<absent>")
+    return h.hexdigest()[:16]
+
+
+def mosaic_gate(kernel: str = "*") -> Tuple[bool, str]:
+    """Is Mosaic dispatch trustworthy here?  Returns ``(ok, reason)``.
+
+    ``ok`` requires: backend probe returned ``"tpu"``, and
+    ``bench/MOSAIC_CHECK.json`` is a hardware stamp (``backend: "tpu"``)
+    with ``ok: true`` and a ``kernel_sha`` matching the current sources.
+    The reason string names the first failed condition."""
+    if os.environ.get("RAFT_MOSAIC_GATE") == "off":
+        return True, "gate bypassed (RAFT_MOSAIC_GATE=off)"
+    backend = probe_backend()
+    if backend is None:
+        return False, "platform probe wedged or failed"
+    if backend != "tpu":
+        return False, f"backend is {backend!r}, not tpu"
+    try:
+        with open(_ARTIFACT) as f:
+            doc = json.load(f)
+    except OSError:
+        return False, f"{os.path.basename(_ARTIFACT)} missing — run " \
+                      f"scripts/mosaic_check.py on this host"
+    except ValueError:
+        return False, f"{os.path.basename(_ARTIFACT)} unreadable"
+    if doc.get("backend") != "tpu":
+        return False, f"artifact is a {doc.get('backend')!r} stamp, not a " \
+                      f"hardware validation"
+    if not doc.get("ok"):
+        return False, "artifact records failed checks"
+    sha = pallas_kernel_sha()
+    if doc.get("kernel_sha") != sha:
+        return False, f"artifact kernel_sha {doc.get('kernel_sha')} is " \
+                      f"stale (sources are {sha})"
+    return True, "validated"
+
+
+def dispatch_mode(kernel: str) -> str:
+    """Resolve one kernel call site to ``"mosaic"`` / ``"interpret"`` /
+    ``"xla"``, memoized per kernel name, logging the reason once on any
+    non-mosaic resolution that a TPU caller would care about."""
+    with _lock:
+        if kernel in _cache:
+            return _cache[kernel]
+    backend = probe_backend()
+    if backend is None:
+        mode = "xla"  # reason already logged by the probe
+    elif backend != "tpu":
+        mode = "interpret"
+    else:
+        ok, reason = mosaic_gate(kernel)
+        mode = "mosaic" if ok else "xla"
+        if not ok:
+            _log_once(f"gate:{kernel}",
+                      "Mosaic gate closed for %s (%s); falling back to the "
+                      "stock XLA path", kernel, reason)
+    with _lock:
+        _cache[kernel] = mode
+    return mode

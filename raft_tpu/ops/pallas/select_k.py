@@ -140,8 +140,20 @@ def select_k_pallas(
     bn = min(bn, max(_LANES, length))
     bm = min(bm, max(8, batch))
     x = in_val if select_min else -in_val
-    interpret = jax.default_backend() != "tpu"
-    val, idx = _call(x, int(k), bm, bn, interpret)
+    # dispatch through the Mosaic gate: on-TPU with a stale MOSAIC_CHECK
+    # stamp or a wedged platform probe this call must NOT attempt Mosaic
+    # lowering — fall back to lax.top_k here (reason logged by the gate)
+    # instead of relying on every caller to pre-check the artifact
+    from .gate import dispatch_mode
+
+    mode = dispatch_mode("select_k")
+    if mode == "xla":
+        neg, idx = jax.lax.top_k(-x, int(k))
+        val = -neg
+        if not select_min:
+            val = -val
+        return val.astype(in_val.dtype), idx
+    val, idx = _call(x, int(k), bm, bn, mode != "mosaic")
     if not select_min:
         val = -val
     return val.astype(in_val.dtype), idx

@@ -29,6 +29,10 @@ sys.path.insert(0, os.path.join(_ROOT, "bench"))
 
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                       os.path.expanduser("~/.cache/raft_tpu_jax"))
+# the validation run itself must exercise Mosaic BEFORE the artifact it
+# writes exists (or when the existing stamp is sha-stale) — bypass the
+# dispatch gate for this process only (ops/pallas/gate.py honors it)
+os.environ.setdefault("RAFT_MOSAIC_GATE", "off")
 
 OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
                    "bench", "MOSAIC_CHECK.json")
@@ -130,6 +134,33 @@ def main() -> None:
     run("fused_shortlist_bf16", lambda: check_shortlist(np.float32))
     run("fused_shortlist_int8", lambda: check_shortlist(np.uint8))
 
+    # --- fused_slab_topk (blocked-scan fused arm) at an IVF-flat slab
+    # shape class: probe_block 8 × cap 512 candidates, bn 512 -------------
+    def check_fused_slab():
+        from raft_tpu.ops.pallas.fused_scan import fused_slab_topk
+
+        nq, c, d, k = 256, 4096, 128, 10
+        vecs1 = rng.normal(size=(nq, c, d)).astype(np.float32)
+        q = rng.normal(size=(nq, d)).astype(np.float32)
+        base = (vecs1 ** 2).sum(axis=2).astype(np.float32)
+        sv, spos = fused_slab_topk(jnp.asarray(vecs1), jnp.asarray(base),
+                                   jnp.asarray(q), bn=512)
+        spos = np.asarray(spos)
+        d2 = base - 2.0 * np.einsum("qcd,qd->qc", vecs1, q)
+        true = np.argsort(d2, axis=1)[:, :k]
+        rec = np.mean([len(set(t) & set(s)) for t, s in zip(true, spos)]) / k
+        assert rec > 0.99, f"fused slab shortlist recall {rec}"
+        if on_tpu:  # Mosaic vs interpret on identical inputs
+            from raft_tpu.ops.pallas.fused_scan import _call
+
+            vb = jnp.asarray(vecs1).astype(jnp.bfloat16)
+            qb = jnp.asarray(q).astype(jnp.bfloat16)
+            ref = _call(qb, vb, jnp.asarray(base), 8, 512, True)
+            np.testing.assert_allclose(np.asarray(sv), np.asarray(ref[0]),
+                                       rtol=1e-3, atol=1e-3)
+
+    run("fused_slab_topk_4096_k10", lambda: check_fused_slab())
+
     # --- bin_select (XLA two-pass path, no Pallas — still worth a TPU
     # compile pass since kAuto can dispatch production rows onto it) ------
     def check_bin_select():
@@ -143,11 +174,22 @@ def main() -> None:
     run("bin_select_16384_k64", lambda: check_bin_select())
 
     ok = all(c["ok"] for c in checks.values())
+    from raft_tpu.ops.pallas.gate import pallas_kernel_sha
+
     art = {"backend": backend, "mosaic": on_tpu,
            "date": datetime.date.today().isoformat(),
+           # the sha the dispatch gate (ops/pallas/gate.py) validates the
+           # stamp against — a stamp from older kernel sources is stale
+           "kernel_sha": pallas_kernel_sha(),
            "ok": ok, "checks": checks}
-    # only a real-hardware pass may overwrite a previous real-hardware stamp
-    if on_tpu or not os.path.exists(OUT):
+    # only a real-hardware pass may overwrite a previous real-hardware
+    # stamp; a CPU smoke may refresh a CPU (or unreadable) stamp
+    try:
+        with open(OUT) as f:
+            prev_tpu = json.load(f).get("backend") == "tpu"
+    except (OSError, ValueError):
+        prev_tpu = False
+    if on_tpu or not prev_tpu:
         with open(OUT, "w") as f:
             json.dump(art, f, indent=1)
             f.write("\n")

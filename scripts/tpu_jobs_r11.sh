@@ -1,0 +1,92 @@
+#!/bin/bash
+# Round-11 TPU job queue: first hardware round for the shared blocked-scan
+# core + fused Pallas slab top-k kernel.
+#   * mosaic must re-stamp bench/MOSAIC_CHECK.json BEFORE any bench/tuner
+#     consults the gate: r11 added fused_slab_topk to the checker and the
+#     dispatch gate (ops/pallas/gate.py) now rejects stamps whose
+#     kernel_sha doesn't match the sources — the committed CPU stamp
+#     deliberately keeps the gate closed until this step passes on TPU.
+#   * fused_scan — bench/fused_scan.py microbench: per-engine vs
+#     shared-core A/B plus the fused-arm interpret probe, the hardware
+#     counterpart of the committed bench/FUSED_SCAN_CPU.json.
+#   * tuner (tune_select_k.py) now also sweeps the fused-vs-xla scan arm
+#     and writes raft_tpu/ops/_scan_kernel_table.json — it must run
+#     after mosaic so "auto" resolutions during the ann A/B are real.
+# Stage order: jaxlint -> mosaic -> fused_scan microbench -> tuners ->
+# bench.py -> prims -> cagra quality.
+# Markers stay in /tmp/tpu_jobs_r3 so steps completed by earlier rounds'
+# queues are not repeated.
+set -u
+cd /root/repo || exit 1
+LOG=/tmp/tpu_jobs_r3
+mkdir -p "$LOG"
+. "$(dirname "$0")/tpu_queue_lib.sh"
+acquire_queue_lock tpu_jobs_r11
+export RAFT_BENCH_CKPT_DIR="$LOG/bench_ckpt"
+
+# r11 moved the fold/scoring core into ops/blocked_scan.py and re-keyed
+# the select_k tuner sha over the fused-scan sources: pre-r11 markers for
+# mosaic/tuner/bench latched against kernels that no longer exist
+if [ -f "$LOG/mosaic.done" ] && \
+    ! grep -q '"kernel_sha"' bench/MOSAIC_CHECK.json 2>/dev/null; then
+  echo "$(date) removing pre-r11 mosaic.done (stamp lacks kernel_sha)" \
+    >> "$LOG/driver.log"
+  rm -f "$LOG/mosaic.done"
+fi
+if [ -f "$LOG/tuner.done" ] && \
+    [ ! -f raft_tpu/ops/_scan_kernel_table.json ]; then
+  echo "$(date) removing pre-r11 tuner.done (no scan-kernel table)" \
+    >> "$LOG/driver.log"
+  rm -f "$LOG/tuner.done"
+fi
+
+echo "$(date) [r11 queue] waiting for TPU..." >> "$LOG/driver.log"
+wait_probe
+echo "$(date) TPU is back" >> "$LOG/driver.log"
+
+run_step() {  # name, timeout_s, command...   (two attempts, gated .done)
+  local name=$1 tmo=$2; shift 2
+  [ -f "$LOG/$name.done" ] && return 0
+  local attempt
+  for attempt in 1 2; do
+    echo "$(date) start $name (attempt $attempt)" >> "$LOG/driver.log"
+    timeout "$tmo" "$@" > "$LOG/$name.$attempt.log" 2>&1 9<&-
+    rc=$?
+    cp -f "$LOG/$name.$attempt.log" "$LOG/$name.log"  # latest = canonical
+    if [ "$rc" -eq 0 ]; then
+      if [ "$name" != bench ] || bench_measured "$LOG/$name.log" brute_force; then
+        touch "$LOG/$name.done"
+        echo "$(date) done $name" >> "$LOG/driver.log"
+        return 0
+      fi
+      echo "$(date) $name exited 0 with no headline measurement (wedged backend)" \
+        >> "$LOG/driver.log"
+    else
+      echo "$(date) FAILED $name (rc=$rc)" >> "$LOG/driver.log"
+    fi
+    # a killed/wedged client can poison the tunnel for the next step too:
+    # re-probe before the retry (or before handing on to the next step)
+    wait_probe
+  done
+}
+
+# jaxlint first: pure-host AST pass, ~seconds, zero chip time
+run_step jaxlint        300 python scripts/mini_lint.py --jax raft_tpu --stats-json bench/JAXLINT.json
+# mosaic BEFORE anything that dispatches Pallas: re-validates every
+# kernel (incl. the new fused_slab_topk) on hardware and stamps the
+# sha-scoped artifact the dispatch gate requires
+run_step mosaic         900 env RAFT_MOSAIC_REQUIRE_TPU=1 python scripts/mosaic_check.py
+# fused-kernel microbench: per-engine-vs-shared-core A/B on hardware (the
+# shared_core tag pairs with the committed per_engine baseline), plus the
+# fused-arm probe
+run_step fused_scan    2400 python bench/fused_scan.py --tag shared_core_tpu --out "$LOG/FUSED_SCAN_TPU.json"
+# tuners before the big benches (resume checkpoints are sha-scoped);
+# tune_select_k's fused arm writes raft_tpu/ops/_scan_kernel_table.json,
+# which "auto" engines consult during the ann A/B below
+run_step tuner         3000 python bench/tune_select_k.py
+run_step probe_tuner   3000 python bench/tune_probe_block.py
+run_step bench         4500 python bench.py
+[ -f "$LOG/bench.done" ] && rm -rf "$RAFT_BENCH_CKPT_DIR"
+run_step prims         3000 python bench/prims.py
+run_step cagra_quality 3000 python bench/cagra_quality.py
+echo "$(date) all steps attempted" >> "$LOG/driver.log"
