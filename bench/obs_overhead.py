@@ -1,0 +1,157 @@
+"""Telemetry overhead microbench — spans ON vs OFF on the serve hot path.
+
+Drives one step-mode :class:`raft_tpu.serve.SearchServer` through a
+fixed request count twice: once with the flight recorder enabled (the
+shipping default) and once with it disabled, plus a raw
+``SpanRecorder`` op-cost table (span / event / post-hoc record).  The
+headline metric is the **per-request telemetry cost in microseconds**
+and its fraction of the request's own latency — the "low-overhead"
+claim of ISSUE 9 as a number that gets re-measured every round instead
+of asserted in prose.
+
+The bound asserted here (and pinned by the committed
+``bench/OBS_OVERHEAD_CPU.json``) is deliberately loose — CI boxes
+jitter — but catches the failure class that matters: a lock or an
+allocation landing on the per-record path turns ~µs into ~ms and trips
+it immediately.
+
+Prints one JSON line per phase and ONE final JSON line in the
+``bench.py`` driver format.
+
+Scale knobs (CPU smoke -> TPU record):
+  RAFT_BENCH_OBS_ROWS      index rows           (default 20_000)
+  RAFT_BENCH_OBS_DIM       vector dim           (default 64)
+  RAFT_BENCH_OBS_REQUESTS  requests per phase   (default 400)
+  RAFT_BENCH_OBS_MAX_FRAC  overhead budget as a fraction of the
+                           spans-off request latency (default 0.05)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.expanduser("~/.cache/raft_tpu_jax"))
+
+import jax  # noqa: E402
+
+from _platform import pin_backend  # noqa: E402
+
+pin_backend(sys.argv)
+
+import numpy as np  # noqa: E402
+
+from raft_tpu.obs import SpanRecorder  # noqa: E402
+from raft_tpu.serve import SearchServer, ServerConfig  # noqa: E402
+
+ROWS = int(os.environ.get("RAFT_BENCH_OBS_ROWS", "20000"))
+DIM = int(os.environ.get("RAFT_BENCH_OBS_DIM", "64"))
+REQUESTS = int(os.environ.get("RAFT_BENCH_OBS_REQUESTS", "400"))
+MAX_FRAC = float(os.environ.get("RAFT_BENCH_OBS_MAX_FRAC", "0.05"))
+
+
+def _drive(recorder: SpanRecorder, queries, db) -> dict:
+    """Step-driven closed loop: one request per step, fixed bucket."""
+    srv = SearchServer(db, k=10, config=ServerConfig(ladder=(8,)),
+                       recorder=recorder)
+    srv.warmup()
+    for j in range(8):  # absorb first-dispatch costs outside the window
+        fut = srv.submit(queries[j % len(queries)])
+        srv.step()
+        fut.result(timeout=30)
+    t0 = time.perf_counter()
+    for j in range(REQUESTS):
+        fut = srv.submit(queries[j % len(queries)])
+        srv.step()
+        fut.result(timeout=30)
+    dt = time.perf_counter() - t0
+    snap = srv.metrics.snapshot()
+    return {"wall_s": round(dt, 4),
+            "us_per_request": round(dt / REQUESTS * 1e6, 2),
+            "p50_ms": snap["latency_ms"]["p50"],
+            "completed": snap["completed"],
+            "spans_recorded": recorder.stats()["recorded"]}
+
+
+def _op_costs() -> dict:
+    """Raw recorder op cost (ns/op) with no server in the way."""
+    rec = SpanRecorder(4096)
+    reps = 20_000
+    out = {}
+
+    def best_of(fn, n=3):
+        best = float("inf")
+        for _ in range(n):
+            t0 = time.perf_counter_ns()
+            fn()
+            best = min(best, (time.perf_counter_ns() - t0) / reps)
+        return round(best, 1)
+
+    def spans():
+        for _ in range(reps):
+            with rec.span("bench.span", bucket=8):
+                pass
+
+    def events():
+        for _ in range(reps):
+            rec.event("bench.event", reason="x")
+
+    def records():
+        for _ in range(reps):
+            rec.record("bench.record", 1, 2, part=0)
+
+    out["span_ns"] = best_of(spans)
+    out["event_ns"] = best_of(events)
+    out["record_ns"] = best_of(records)
+    rec.enabled = False
+    out["disabled_span_ns"] = best_of(spans)
+    return out
+
+
+def main() -> int:
+    rng = np.random.default_rng(7)
+    db = rng.standard_normal((ROWS, DIM)).astype(np.float32)
+    queries = [rng.standard_normal((4, DIM)).astype(np.float32)
+               for _ in range(16)]
+
+    ops = _op_costs()
+    print(json.dumps({"config": "obs_op_costs", **ops}), flush=True)
+
+    on = _drive(SpanRecorder(4096), queries, db)
+    off = _drive(SpanRecorder(4096, enabled=False), queries, db)
+    print(json.dumps({"config": "spans_on", **on}), flush=True)
+    print(json.dumps({"config": "spans_off", **off}), flush=True)
+
+    overhead_us = on["us_per_request"] - off["us_per_request"]
+    frac = overhead_us / off["us_per_request"]
+    final = {
+        "metric": "obs_overhead_us_per_request",
+        "value": round(overhead_us, 2),
+        "unit": f"us@{REQUESTS}req",
+        "fraction_of_request": round(frac, 4),
+        "budget_fraction": MAX_FRAC,
+        "backend": jax.default_backend(),
+        "rows": ROWS, "dim": DIM, "requests": REQUESTS,
+        "op_costs_ns": ops,
+        "points": [{"config": "spans_on", **on},
+                   {"config": "spans_off", **off}],
+    }
+    print(json.dumps(final, indent=2 if sys.stdout.isatty() else None),
+          flush=True)
+    # the bound: telemetry must stay a rounding error on the request.
+    # A negative overhead just means the delta drowned in scheduler noise.
+    assert frac <= MAX_FRAC, (
+        f"telemetry overhead {overhead_us:.1f}us/request is "
+        f"{frac:.1%} of the spans-off request ({off['us_per_request']}us) "
+        f"— budget {MAX_FRAC:.0%}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

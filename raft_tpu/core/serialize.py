@@ -36,6 +36,7 @@ __all__ = [
     "verify_arrays",
     "CorruptArtifact",
     "fsync_dir",
+    "write_text_atomic",
 ]
 
 
@@ -91,6 +92,26 @@ def _write_file(path: str, data: bytes, fsync: bool) -> None:
         if fsync:
             f.flush()
             os.fsync(f.fileno())
+
+
+def write_text_atomic(path: Union[str, os.PathLike], text: str) -> str:
+    """Publish a small text artifact (metrics snapshot, trace dump) with
+    the crash-consistent single-file discipline: write to a sibling temp
+    file, fsync it, ``os.replace`` onto ``path`` (atomic on POSIX), then
+    fsync the directory.  A crash at any point leaves either the old
+    complete file or the new complete file — never a torn one.  Returns
+    ``path``."""
+    path = os.fspath(path)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    try:
+        _write_file(tmp, text.encode(), fsync=True)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    fsync_dir(os.path.dirname(os.path.abspath(path)) or ".")
+    return path
 
 
 def save_arrays(path: Union[str, os.PathLike], arrays: Dict[str, Any],

@@ -5,6 +5,21 @@ compiled out unless ``RAFT_NVTX`` is on.  The TPU analog is
 ``jax.profiler.TraceAnnotation`` (shows up in XProf/Perfetto timelines) plus
 ``jax.named_scope`` so the annotation also lands in HLO names.  Enabled by
 default; set ``RAFT_TPU_TRACING=0`` to compile it out to a no-op.
+
+Unified with :mod:`raft_tpu.obs` (ISSUE 9): every range additionally
+records a structured span into the process flight recorder
+(:func:`raft_tpu.obs.spans.recorder`), auto-parented by the calling
+thread's open ranges — so engine/build/serve annotations that used to be
+profiler-only are retained in the always-on ring buffer and come out in
+stall dumps and Perfetto exports.  ``RAFT_OBS_SPANS=0`` disables just
+the recording half; ``RAFT_TPU_TRACING=0`` disables both.
+
+Push/pop discipline (satellite of ISSUE 9): :func:`pop_range` is safe on
+an empty per-thread stack (returns ``False`` and counts
+``raft_tracing_unbalanced_pops_total`` instead of raising or silently
+hiding the imbalance) and is exception-safe — the obs span always
+finishes and the stack entry always pops, even when the underlying
+annotation's ``__exit__`` raises.
 """
 
 from __future__ import annotations
@@ -16,7 +31,7 @@ from functools import wraps
 
 import jax
 
-__all__ = ["range", "annotate", "push_range", "pop_range"]
+__all__ = ["range", "annotate", "push_range", "pop_range", "stack_depth"]
 
 _ENABLED = os.environ.get("RAFT_TPU_TRACING", "1") != "0"
 _tls = threading.local()
@@ -29,18 +44,28 @@ def _stack() -> list:
     return _tls.stack
 
 
+def _recorder():
+    from ..obs.spans import recorder
+
+    return recorder()
+
+
 @contextlib.contextmanager
 def range(fmt: str, *args):
     """RAII-style range (``nvtx::range`` parity). Usage::
 
         with tracing.range("select_k(batch=%d,k=%d)", batch, k):
             ...
+
+    Emits the profiler annotation + HLO scope AND a flight-recorder span
+    (auto-parented to the innermost open range/span on this thread).
     """
     if not _ENABLED:
         yield
         return
     name = (fmt % args) if args else fmt
-    with jax.profiler.TraceAnnotation(name), jax.named_scope(name):
+    with jax.profiler.TraceAnnotation(name), jax.named_scope(name), \
+            _recorder().span(name):
         yield
 
 
@@ -51,15 +76,36 @@ def push_range(fmt: str, *args) -> None:
     name = (fmt % args) if args else fmt
     cm = jax.profiler.TraceAnnotation(name)
     cm.__enter__()
-    _stack().append(cm)
+    span = _recorder().start(name)
+    _stack().append((cm, span))
 
 
-def pop_range() -> None:
+def pop_range() -> bool:
+    """Pop the innermost pushed range.  Returns ``True`` when a range was
+    popped; an unbalanced pop (empty stack) is a counted no-op — see the
+    module docstring.  The flight-recorder span finishes even when the
+    annotation's ``__exit__`` raises."""
     if not _ENABLED:
-        return
+        return False
     stack = _stack()
-    if stack:
-        stack.pop().__exit__(None, None, None)
+    if not stack:
+        from ..obs.metrics import registry
+
+        registry().counter(
+            "raft_tracing_unbalanced_pops_total",
+            "pop_range() calls with no matching push_range()").inc()
+        return False
+    cm, span = stack.pop()
+    try:
+        cm.__exit__(None, None, None)
+    finally:
+        _recorder().finish(span)
+    return True
+
+
+def stack_depth() -> int:
+    """Open pushed ranges on the calling thread (test/debug surface)."""
+    return len(_stack())
 
 
 def annotate(name: str = None):

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..core import tracing
 from ..core.array import wrap_array
 from ..core.compat import shard_map
 from ..core.errors import expects
@@ -295,6 +296,7 @@ def _bitmap_max_exclusions(filter_obj, keep):
         return None
 
 
+@tracing.annotate("brute_force.knn")
 def knn(
     queries,
     database,

@@ -41,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..core import tracing
 from ..core.array import wrap_array
 from ..core.compat import shard_map
 from ..core.errors import expects
@@ -318,6 +319,7 @@ def refine_knn_graph(dataset, knn_graph, n_iters: int = 1, *,
     return g
 
 
+@tracing.annotate("cagra.build")
 def build(dataset, params: Optional[CagraIndexParams] = None, *,
           res=None) -> CagraIndex:
     """Build the optimized graph from scratch."""
@@ -955,6 +957,7 @@ def search_sharded(index: ShardedCagraIndex, queries, k: int,
     return dv, di
 
 
+@tracing.annotate("cagra.search")
 def search(index: CagraIndex, queries, k: int,
            params: Optional[CagraSearchParams] = None, *, filter=None,
            seed: int = 0, res=None) -> Tuple[jax.Array, jax.Array]:

@@ -38,6 +38,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..cluster.kmeans import KMeansParams, capped_assign, kmeans_balanced_fit
+from ..core import tracing
 from ..core.array import wrap_array
 from ..core.compat import shard_map
 from ..core.errors import expects
@@ -115,6 +116,7 @@ class IvfFlatIndex:
         return int(jnp.sum(self.counts))  # jaxlint: disable=JX01 size is a host-facing API scalar, not on the search path
 
 
+@tracing.annotate("ivf_flat.build")
 def build(dataset, params: Optional[IvfFlatIndexParams] = None, *,
           source_ids=None, res=None) -> IvfFlatIndex:
     """Train the coarse quantizer and pack inverted lists (all on device —
@@ -465,6 +467,7 @@ def _search_impl(centroids, data, ids, counts, norms, q, k: int,
     return bv, bi
 
 
+@tracing.annotate("ivf_flat.search")
 def search(index: IvfFlatIndex, queries, k: int,
            params: Optional[IvfFlatSearchParams] = None, *, filter=None,
            res=None) -> Tuple[jax.Array, jax.Array]:

@@ -52,6 +52,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..cluster.kmeans import KMeansParams, capped_assign, kmeans_balanced_fit
+from ..core import tracing
 from ..core.array import wrap_array
 from ..core.compat import shard_map
 from ..core.errors import expects
@@ -375,6 +376,7 @@ def _unpack_codes4(packed: jax.Array, m: int) -> jax.Array:
     return inter[..., :m].astype(jnp.uint8)
 
 
+@tracing.annotate("ivf_pq.build")
 def build(dataset, params: Optional[IvfPqIndexParams] = None, *,
           source_ids=None, res=None) -> IvfPqIndex:
     p = params or IvfPqIndexParams()
@@ -837,6 +839,7 @@ def _search_lut_impl(centroids, codebooks, codes, adc_norms, ids, counts, q,
     return bv, bi
 
 
+@tracing.annotate("ivf_pq.search")
 def search(index: IvfPqIndex, queries, k: int,
            params: Optional[IvfPqSearchParams] = None, *, filter=None,
            res=None) -> Tuple[jax.Array, jax.Array]:

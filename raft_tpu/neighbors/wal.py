@@ -52,8 +52,10 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..core.errors import expects
+from ..core import tracing
 from ..core.serialize import (CorruptArtifact, deserialize_mdspan, fsync_dir,
                               npy_bytes)
+from ..obs import spans as obs_spans
 from .serialize import index_manifest, load_index, save_index, verify_index
 
 __all__ = ["WalConfig", "WalRecord", "WriteAheadLog", "read_wal",
@@ -366,7 +368,7 @@ class DurableStore:
             crash_site="compact")
 
     def _durable(self, op, arrays, static, *, crash_site: str):
-        with self._lock:
+        with self._lock, tracing.range("wal.durable(%s)", op):
             expects(self.index is not None, "store has no index (use "
                     "DurableStore.create or DurableStore.recover)")
             # corrupt-kind faults at this site byte-flip the existing log
@@ -390,7 +392,7 @@ class DurableStore:
         ditto) leaves the previous snapshot authoritative and recovery
         replays a longer WAL tail.  Prunes to
         ``WalConfig.retain_snapshots`` published snapshots."""
-        with self._lock:
+        with self._lock, tracing.range("wal.snapshot"):
             expects(self.index is not None, "store has no index")
             self.wal.sync()  # the manifest must never lead the disk
             lsn = self.wal.lsn
@@ -438,6 +440,8 @@ class DurableStore:
         with open(dest + ".reason", "w") as f:
             f.write(reason + "\n")
         self._count("quarantined_files")
+        obs_spans.recorder().event("wal.quarantine", artifact=base,
+                                   reason=reason)
 
     @classmethod
     def recover(cls, root, *, config: Optional[WalConfig] = None,
@@ -449,6 +453,7 @@ class DurableStore:
         torn/corrupt tail is quarantined + truncated first), and the
         returned store is ready to mutate and snapshot again.  Raises
         :class:`CorruptArtifact` when no valid snapshot survives."""
+        t_recover = obs_spans.recorder().clock_ns()
         self = cls.__new__(cls)
         self.root = os.fspath(root)
         self.snap_dir = os.path.join(self.root, "snapshots")
@@ -507,6 +512,11 @@ class DurableStore:
         self.wal = WriteAheadLog(wal_path, self.config, clock=clock,
                                  _fsync=_fsync)
         self._count("recoveries")
+        rec = obs_spans.recorder()
+        rec.record("wal.recover", t_recover, rec.clock_ns(),
+                   replayed=self.counters.get("wal_replayed", 0),
+                   quarantined=self.counters.get("quarantined_files", 0),
+                   watermark=watermark)
         return self
 
     def close(self) -> None:

@@ -1,0 +1,69 @@
+"""raft_tpu.obs — unified telemetry: spans, metrics, exporters, watchdog.
+
+The system's self-knowledge used to be fragmented — flat JSON counters
+in ``serve.metrics``, uncollected profiler annotations in
+``core/tracing``, gate fallbacks lost in the log stream, wedged-TPU
+failures (BENCH_r04/r05) leaving no evidence.  This package is the one
+substrate they all report through:
+
+* **spans** (:mod:`.spans`) — monotonic-clock :class:`Span` trees with
+  attributes, recorded into lock-cheap per-thread ring buffers: an
+  always-on **flight recorder**.  ``core/tracing`` ranges feed it, the
+  serve request lifecycle (enqueue → batch-form → dispatch →
+  device-exec → reply) threads explicit parents through it, and WAL /
+  snapshot / recovery / compaction annotate into it.
+* **metrics** (:mod:`.metrics`) — counters, gauges and fixed-boundary
+  **mergeable** histograms in a :class:`MetricRegistry`; the
+  process-global :func:`registry` collects library-level events such as
+  Pallas gate fallbacks (counted, with ``kernel``/``reason`` labels,
+  instead of log lines).
+* **exporters** — Prometheus text exposition (:mod:`.prometheus`) and
+  Chrome-trace/Perfetto JSON of the flight recorder (:mod:`.perfetto`);
+  the serving JSON schema (``SearchServer.metrics_snapshot``) is
+  unchanged and now derivable from the same registry.
+* **watchdog** (:mod:`.watchdog`) — :class:`StallWatchdog` detects a
+  wedged device dispatch, dumps flight recorder + ``jax.profiler``
+  capture to a quarantine directory, and counts ``stalls`` instead of
+  hanging silently.
+
+Everything except the profiler capture is pure stdlib: importable
+without jax, zero device interaction, safe on any host.  See
+``docs/observability_guide.md`` for the span API, exporter endpoints and
+the stall runbook.
+
+>>> from raft_tpu import obs
+>>> rec = obs.SpanRecorder(capacity_per_thread=8)
+>>> with rec.span("request", rows=2) as root:
+...     with rec.span("dispatch"):
+...         pass
+>>> [s.name for s in rec.snapshot()]
+['request', 'dispatch']
+>>> rec.snapshot()[1].parent_id == root.span_id
+True
+"""
+
+from .metrics import (DEFAULT_LATENCY_BOUNDARIES_MS, Counter, Gauge,
+                      Histogram, MetricRegistry, registry, set_registry)
+from .perfetto import chrome_trace, export_chrome_trace
+from .prometheus import parse_text, render
+from .spans import Span, SpanRecorder, recorder, set_recorder
+from .watchdog import StallWatchdog
+
+__all__ = [
+    "Span",
+    "SpanRecorder",
+    "recorder",
+    "set_recorder",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricRegistry",
+    "registry",
+    "set_registry",
+    "DEFAULT_LATENCY_BOUNDARIES_MS",
+    "render",
+    "parse_text",
+    "chrome_trace",
+    "export_chrome_trace",
+    "StallWatchdog",
+]

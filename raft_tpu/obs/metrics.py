@@ -1,0 +1,237 @@
+"""Metric registry — counters, gauges, fixed-boundary histograms.
+
+The aggregation substrate under the exporters: every metric is a named
+*family* holding one value per label-set, registered in a
+:class:`MetricRegistry` so :mod:`raft_tpu.obs.prometheus` can walk and
+render everything uniformly.
+
+Histograms use **fixed bucket boundaries** (upper bounds, exclusive of
+``+Inf``) chosen at registration.  Unlike the serving reservoir's exact
+window percentiles, fixed-boundary counts are *mergeable*: summing the
+per-replica bucket vectors yields the fleet histogram, which is how
+pod-scale percentiles must be computed (ROADMAP item 4 — reservoirs
+cannot merge).  :meth:`Histogram.quantile` returns the conservative
+upper edge of the bucket containing the quantile, so it can disagree
+with an exact percentile by at most one bucket width — the invariant
+``tests/test_obs.py`` pins against the serving snapshot.
+
+Everything here is pure stdlib (no jax import) so lint/CI tooling and
+the exporters stay accelerator-free.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricRegistry",
+           "registry", "set_registry", "DEFAULT_LATENCY_BOUNDARIES_MS"]
+
+#: Default latency ladder (ms): ~2× steps from sub-ms dispatches to the
+#: multi-second wedge regime.  Mergeable across replicas by construction.
+DEFAULT_LATENCY_BOUNDARIES_MS = (
+    0.5, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0,
+    1000.0, 2000.0, 4000.0, 8000.0)
+
+
+def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class _Family:
+    """Shared label-set plumbing (one value slot per label combination)."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "") -> None:
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._vals: Dict[Tuple, float] = {}
+
+    def samples(self) -> List[Tuple[Dict[str, str], float]]:
+        """``[(labels_dict, value), ...]`` sorted by label key."""
+        with self._lock:
+            items = sorted(self._vals.items())
+        return [(dict(k), v) for k, v in items]
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._vals.get(_label_key(labels), 0.0)
+
+
+class Counter(_Family):
+    """Monotonic count, optionally labelled:
+    ``c.inc(kernel="fused", reason="stale")``."""
+
+    kind = "counter"
+
+    def inc(self, n: float = 1, **labels) -> None:
+        from ..core.errors import expects
+
+        expects(n >= 0, f"counter {self.name} cannot decrease (n={n})")
+        key = _label_key(labels)
+        with self._lock:
+            self._vals[key] = self._vals.get(key, 0.0) + n
+
+
+class Gauge(_Family):
+    """Point-in-time value (queue depth, ring occupancy)."""
+
+    kind = "gauge"
+
+    def set(self, v: float, **labels) -> None:
+        with self._lock:
+            self._vals[_label_key(labels)] = float(v)
+
+
+class Histogram:
+    """Fixed-boundary histogram family (cumulative on export).
+
+    Per label-set state: one count per bucket (+ the ``+Inf`` overflow),
+    the running sum, and the total count — exactly the Prometheus
+    histogram data model, and the mergeable replacement for reservoir
+    percentiles."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "",
+                 boundaries: Sequence[float] =
+                 DEFAULT_LATENCY_BOUNDARIES_MS) -> None:
+        from ..core.errors import expects
+
+        bounds = tuple(float(b) for b in boundaries)
+        expects(len(bounds) >= 1, f"histogram {name} needs >= 1 boundary")
+        expects(all(a < b for a, b in zip(bounds, bounds[1:])),
+                f"histogram {name} boundaries must increase strictly")
+        self.name = name
+        self.help = help
+        self.boundaries = bounds
+        self._lock = threading.Lock()
+        self._counts: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = {}
+
+    def observe(self, v: float, **labels) -> None:
+        i = bisect.bisect_left(self.boundaries, float(v))
+        key = _label_key(labels)
+        with self._lock:
+            counts = self._counts.get(key)
+            if counts is None:
+                counts = [0] * (len(self.boundaries) + 1)
+                self._counts[key] = counts
+                self._sums[key] = 0.0
+            counts[i] += 1
+            self._sums[key] += float(v)
+
+    def samples(self) -> List[Tuple[Dict[str, str], List[int], float]]:
+        """``[(labels, bucket_counts_incl_inf, sum)]`` per label-set."""
+        with self._lock:
+            items = sorted((k, list(c), self._sums[k])
+                           for k, c in self._counts.items())
+        return [(dict(k), c, s) for k, c, s in items]
+
+    def count(self, **labels) -> int:
+        with self._lock:
+            return sum(self._counts.get(_label_key(labels), ()))
+
+    def quantile(self, q: float, **labels) -> float:
+        """Conservative quantile: the upper boundary of the bucket where
+        the cumulative count reaches ``q`` (0 < q <= 1).  Differs from an
+        exact percentile over the same observations by at most one bucket
+        width; returns the top finite boundary for overflow quantiles and
+        0.0 when empty."""
+        from ..core.errors import expects
+
+        expects(0.0 < q <= 1.0, "quantile q must lie in (0, 1]")
+        with self._lock:
+            counts = list(self._counts.get(_label_key(labels), ()))
+        total = sum(counts)
+        if total == 0:
+            return 0.0
+        need = q * total
+        cum = 0
+        for i, c in enumerate(counts[:-1]):
+            cum += c
+            if cum >= need:
+                return self.boundaries[i]
+        return self.boundaries[-1]
+
+    def bucket_width(self, v: float) -> float:
+        """Width of the bucket containing ``v`` — the exporter-vs-exact
+        agreement tolerance (overflow bucket reports the top span)."""
+        i = bisect.bisect_left(self.boundaries, float(v))
+        if i >= len(self.boundaries):
+            i = len(self.boundaries) - 1
+        lo = self.boundaries[i - 1] if i > 0 else 0.0
+        return self.boundaries[i] - lo
+
+
+class MetricRegistry:
+    """Ordered name -> metric map with idempotent typed registration:
+    re-registering an existing name returns the existing family (so call
+    sites need no globals), re-registering under a different type is an
+    error."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, object] = {}
+
+    def _get(self, name: str, kind, factory):
+        from ..core.errors import expects
+
+        with self._lock:
+            hit = self._metrics.get(name)
+            if hit is not None:
+                expects(isinstance(hit, kind),
+                        f"metric {name!r} already registered as "
+                        f"{type(hit).__name__}, not {kind.__name__}")
+                return hit
+            m = factory()
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(name, Counter, lambda: Counter(name, help))
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(name, Gauge, lambda: Gauge(name, help))
+
+    def histogram(self, name: str, help: str = "",
+                  boundaries: Sequence[float] =
+                  DEFAULT_LATENCY_BOUNDARIES_MS) -> Histogram:
+        return self._get(name, Histogram,
+                         lambda: Histogram(name, help, boundaries))
+
+    def collect(self) -> List[object]:
+        """Registration-ordered metric families (dicts preserve insertion
+        order — exposition output is deterministic)."""
+        with self._lock:
+            return list(self._metrics.values())
+
+    def get(self, name: str) -> Optional[object]:
+        with self._lock:
+            return self._metrics.get(name)
+
+
+_default: Optional[MetricRegistry] = None
+_default_lock = threading.Lock()
+
+
+def registry() -> MetricRegistry:
+    """The process-wide registry — library-level events (Pallas gate
+    fallbacks, tracing diagnostics) land here so one exposition covers
+    code that has no server handle."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = MetricRegistry()
+        return _default
+
+
+def set_registry(reg: MetricRegistry) -> MetricRegistry:
+    """Swap the process-wide registry (tests).  Returns the previous."""
+    global _default
+    with _default_lock:
+        prev, _default = _default, reg
+        return prev if prev is not None else reg

@@ -1,0 +1,90 @@
+"""Chrome-trace / Perfetto JSON export of the flight recorder.
+
+Renders a list of :class:`~raft_tpu.obs.spans.Span` to the Trace Event
+Format (the ``traceEvents`` JSON that both ``chrome://tracing`` and
+https://ui.perfetto.dev open directly): one complete (``"ph": "X"``)
+event per span on its recording thread's track, thread-name metadata
+events, and the span/parent/trace ids in ``args`` so tooling (and the
+acceptance test) can rebuild the exact tree even where parent and child
+ran on different threads — Perfetto's own nesting view is per-track;
+the cross-thread request lineage additionally gets flow events
+(``"ph": "s"/"f"``) drawn as arrows from parent to child track.
+
+This is the *flight-recorder* view (host-side spans: queue wait,
+batch-form, dispatch, device-exec, WAL, compaction).  Device-internal
+timelines still come from ``jax.profiler`` captures — the watchdog dumps
+both side by side for a wedged dispatch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterable, List
+
+from .spans import Span
+
+__all__ = ["chrome_trace", "export_chrome_trace"]
+
+
+def chrome_trace(spans: Iterable[Span], *,
+                 process_name: str = "raft_tpu") -> Dict:
+    """Trace Event Format dict for ``spans`` (open spans are skipped —
+    a flight-recorder dump happens mid-flight by definition)."""
+    pid = os.getpid()
+    spans = [s for s in spans if s.t_end_ns]
+    events: List[Dict] = [{
+        "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+        "args": {"name": process_name},
+    }]
+    named: set = set()
+    for s in spans:
+        if s.tid not in named:
+            named.add(s.tid)
+            events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                           "tid": s.tid, "args": {"name": s.thread_name}})
+    by_id = {s.span_id: s for s in spans}
+    for s in spans:
+        ts_us = s.t_start_ns / 1e3
+        events.append({
+            "name": s.name, "ph": "X", "pid": pid, "tid": s.tid,
+            "ts": ts_us,
+            # sub-us floor keeps instant events visible as slivers
+            "dur": max(s.duration_ns / 1e3, 0.001),
+            "args": {"span_id": s.span_id, "parent_id": s.parent_id,
+                     "trace_id": s.trace_id,
+                     **{k: _jsonable(v) for k, v in s.attrs.items()}},
+        })
+        parent = by_id.get(s.parent_id)
+        if parent is not None and parent.tid != s.tid:
+            # flow arrow from the parent's track to the child's: the
+            # cross-thread request lineage stays visible in the UI
+            mid_us = parent.t_start_ns / 1e3 + \
+                max(parent.duration_ns / 2e3, 0.001)
+            events.append({"name": s.name, "cat": "flow", "ph": "s",
+                           "id": s.span_id, "pid": pid, "tid": parent.tid,
+                           "ts": mid_us})
+            events.append({"name": s.name, "cat": "flow", "ph": "f",
+                           "bp": "e", "id": s.span_id, "pid": pid,
+                           "tid": s.tid, "ts": ts_us})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def _jsonable(v):
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    return repr(v)
+
+
+def export_chrome_trace(path, spans: Iterable[Span], *,
+                        process_name: str = "raft_tpu") -> str:
+    """Write :func:`chrome_trace` as JSON via the crash-consistent
+    temp + fsync + rename discipline (a stall dump must never itself be
+    a torn file).  Returns ``path``."""
+    from ..core.serialize import write_text_atomic
+
+    doc = chrome_trace(spans, process_name=process_name)
+    write_text_atomic(path, json.dumps(doc) + "\n")
+    return os.fspath(path)

@@ -1,0 +1,128 @@
+"""Prometheus text exposition (version 0.0.4) over a MetricRegistry.
+
+:func:`render` walks one or more registries and emits the scrapeable
+text format: ``# HELP`` / ``# TYPE`` headers, labelled samples,
+histograms as cumulative ``_bucket{le=...}`` series plus ``_sum`` /
+``_count``.  Because the histograms carry fixed boundaries
+(:mod:`raft_tpu.obs.metrics`), the emitted series are mergeable across
+replicas — ``histogram_quantile()`` over a fleet sum is exact to one
+bucket width, which reservoir p95s can never promise.
+
+:func:`parse_text` is the inverse for the subset this module emits —
+enough for tests and runbooks to assert on a scrape without a Prometheus
+install (it is NOT a general exposition parser).
+
+No HTTP server is shipped on purpose: serving one GET is three lines of
+stdlib (see ``docs/observability_guide.md``) and every deployment
+already has an opinion about its HTTP stack.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, Iterable, List, Tuple, Union
+
+from .metrics import Counter, Gauge, Histogram, MetricRegistry
+
+__all__ = ["render", "parse_text"]
+
+_NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+
+def _fmt_value(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+def _fmt_labels(labels: Dict[str, str], extra: str = "") -> str:
+    parts = [f'{k}="{_escape(v)}"' for k, v in sorted(labels.items())]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _escape(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+                 .replace("\n", "\\n")
+
+
+def render(registries: Union[MetricRegistry,
+                             Iterable[MetricRegistry]]) -> str:
+    """One scrape body over ``registries`` (a registry or an iterable —
+    e.g. a server's own registry plus the process-global one).  Duplicate
+    family names across registries keep the first occurrence: the caller
+    ordered them by precedence."""
+    if isinstance(registries, MetricRegistry):
+        registries = (registries,)
+    out: List[str] = []
+    seen: set = set()
+    for reg in registries:
+        for metric in reg.collect():
+            if metric.name in seen:
+                continue
+            seen.add(metric.name)
+            name = metric.name
+            if not _NAME_OK.match(name):  # pragma: no cover - registration bug
+                continue
+            if metric.help:
+                out.append(f"# HELP {name} {_escape(metric.help)}")
+            out.append(f"# TYPE {name} {metric.kind}")
+            if isinstance(metric, Histogram):
+                for labels, counts, total in metric.samples():
+                    cum = 0
+                    for bound, c in zip(metric.boundaries, counts):
+                        cum += c
+                        le = f'le="{_fmt_value(bound)}"'
+                        out.append(f"{name}_bucket{_fmt_labels(labels, le)}"
+                                   f" {cum}")
+                    cum += counts[-1]
+                    inf_label = 'le="+Inf"'
+                    out.append(f"{name}_bucket{_fmt_labels(labels, inf_label)}"
+                               f" {cum}")
+                    out.append(f"{name}_sum{_fmt_labels(labels)}"
+                               f" {_fmt_value(total)}")
+                    out.append(f"{name}_count{_fmt_labels(labels)} {cum}")
+            elif isinstance(metric, (Counter, Gauge)):
+                for labels, v in metric.samples():
+                    out.append(f"{name}{_fmt_labels(labels)} {_fmt_value(v)}")
+                if not metric.samples():
+                    # a registered-but-never-incremented unlabelled family
+                    # still exposes 0 so absence is distinguishable from
+                    # a scrape miss
+                    out.append(f"{name} 0")
+    return "\n".join(out) + "\n"
+
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})?\s+(\S+)$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape(v: str) -> str:
+    # single pass so '\\n' stays a literal backslash-n, not a newline
+    return re.sub(r"\\(.)",
+                  lambda m: "\n" if m.group(1) == "n" else m.group(1), v)
+
+
+def parse_text(text: str) -> Dict[str, List[Tuple[Dict[str, str], float]]]:
+    """Parse exposition text back to
+    ``{sample_name: [(labels, value), ...]}`` (sample names include the
+    ``_bucket``/``_sum``/``_count`` suffixes).  Raises ``ValueError`` on
+    a line that is neither a comment nor a well-formed sample — the
+    "exposition parses" acceptance check."""
+    samples: Dict[str, List[Tuple[Dict[str, str], float]]] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip() or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError(f"line {lineno}: unparseable sample {line!r}")
+        name, _, labelstr, value = m.groups()
+        labels = {k: _unescape(v)
+                  for k, v in _LABEL_RE.findall(labelstr or "")}
+        v = math.inf if value == "+Inf" else float(value)
+        samples.setdefault(name, []).append((labels, v))
+    return samples

@@ -1,0 +1,300 @@
+"""Structured spans + the always-on flight recorder.
+
+A :class:`Span` is a named monotonic-clock interval with attributes,
+a ``trace_id`` grouping one logical operation (e.g. one serve request)
+and a ``parent_id`` forming the tree.  A :class:`SpanRecorder` retains
+finished spans in **per-thread ring buffers** — appends touch only the
+calling thread's ring (no lock on the record path; ring registration
+takes the lock once per thread), so the recorder can stay enabled on the
+serve hot path as a *flight recorder*: when something wedges, the last
+``capacity_per_thread`` spans of every thread are still in memory and
+can be dumped (:mod:`raft_tpu.obs.perfetto`,
+:mod:`raft_tpu.obs.watchdog`).
+
+Span times come from ``time.monotonic_ns`` (injectable), never the wall
+clock — the recorder must keep working while a fake-clock server is
+driven deterministically, and interval math must survive NTP steps.
+
+Parentage is resolved three ways, in order: an explicit ``parent=``
+(a :class:`Span` — the cross-thread case: the serve dispatch thread
+parents its spans under the client thread's request span), else the
+innermost open span **on the calling thread** (``with recorder.span()``
+nesting), else the span roots a fresh trace.
+
+The process-wide default recorder (:func:`recorder` /
+:func:`set_recorder`) is what :mod:`raft_tpu.core.tracing` and the
+serving runtime write into; ``RAFT_OBS_SPANS=0`` starts it disabled and
+``RAFT_OBS_RING`` sizes its rings.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import os
+import threading
+import time
+from typing import Dict, Iterator, List, Optional, Union
+
+__all__ = ["Span", "SpanRecorder", "recorder", "set_recorder"]
+
+# itertools.count.__next__ is atomic under the GIL — ids are unique
+# across threads without a lock.
+_ids = itertools.count(1)
+
+
+class Span:
+    """One named interval.  ``t_end_ns == 0`` while still open; ``attrs``
+    is a plain dict the owner may extend until :meth:`SpanRecorder.finish`
+    (instant events have ``t_end_ns == t_start_ns``)."""
+
+    __slots__ = ("name", "t_start_ns", "t_end_ns", "trace_id", "span_id",
+                 "parent_id", "tid", "thread_name", "attrs")
+
+    def __init__(self, name: str, t_start_ns: int, trace_id: int,
+                 span_id: int, parent_id: Optional[int], tid: int,
+                 thread_name: str, attrs: Dict) -> None:
+        self.name = name
+        self.t_start_ns = t_start_ns
+        self.t_end_ns = 0
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.tid = tid
+        self.thread_name = thread_name
+        self.attrs = attrs
+
+    @property
+    def duration_ns(self) -> int:
+        return max(0, self.t_end_ns - self.t_start_ns)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"Span({self.name!r}, id={self.span_id}, "
+                f"parent={self.parent_id}, trace={self.trace_id}, "
+                f"dur={self.duration_ns / 1e6:.3f}ms, attrs={self.attrs})")
+
+
+class _Ring:
+    """Fixed-capacity overwrite-oldest buffer, owned by ONE thread.
+
+    Appends are unlocked (only the owner writes); ``snapshot`` copies the
+    list reference under the recorder lock and re-orders by append index,
+    tolerating a concurrent append (worst case one torn slot, never a
+    crash — list reads/writes are atomic under the GIL)."""
+
+    __slots__ = ("cap", "buf", "n")
+
+    def __init__(self, cap: int) -> None:
+        self.cap = cap
+        self.buf: List[Span] = []
+        self.n = 0
+
+    def append(self, span: Span) -> None:
+        if len(self.buf) < self.cap:
+            self.buf.append(span)
+        else:
+            self.buf[self.n % self.cap] = span
+        self.n += 1
+
+    def snapshot(self) -> List[Span]:
+        if self.n <= self.cap:
+            return list(self.buf)
+        cut = self.n % self.cap
+        return self.buf[cut:] + self.buf[:cut]
+
+
+class SpanRecorder:
+    """Low-overhead span sink with per-thread flight-recorder rings.
+
+    ``enabled=False`` turns every record call into an early return (the
+    compile-it-out story, like ``RAFT_TPU_TRACING=0``); flipping
+    :attr:`enabled` at runtime is safe — open spans still finish, they
+    are just not retained."""
+
+    def __init__(self, capacity_per_thread: int = 4096, *,
+                 clock_ns=time.monotonic_ns, enabled: bool = True) -> None:
+        from ..core.errors import expects
+
+        expects(capacity_per_thread >= 1,
+                "capacity_per_thread must be >= 1")
+        self.capacity_per_thread = int(capacity_per_thread)
+        self.clock_ns = clock_ns
+        self.enabled = bool(enabled)
+        self._lock = threading.Lock()
+        self._rings: Dict[int, _Ring] = {}      # tid -> ring
+        self._tls = threading.local()
+
+    # -- per-thread state ---------------------------------------------------
+
+    def _ring(self) -> _Ring:
+        ring = getattr(self._tls, "ring", None)
+        if ring is None:
+            ring = _Ring(self.capacity_per_thread)
+            self._tls.ring = ring
+            t = threading.current_thread()
+            self._tls.tid = t.ident or 0
+            self._tls.tname = t.name
+            with self._lock:
+                self._rings[self._tls.tid] = ring
+        return ring
+
+    def _stack(self) -> List[Span]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = []
+            self._tls.stack = stack
+        return stack
+
+    def current(self) -> Optional[Span]:
+        """The innermost open ``with``-span on the calling thread."""
+        stack = self._stack()
+        return stack[-1] if stack else None
+
+    def _lineage(self, parent: Union[Span, int, None]):
+        if parent is None:
+            parent = self.current()
+        if parent is None:
+            span_id = next(_ids)
+            return span_id, span_id, None       # fresh trace, self-rooted
+        if isinstance(parent, Span):
+            return next(_ids), parent.trace_id, parent.span_id
+        return next(_ids), int(parent), int(parent)
+
+    # -- recording ----------------------------------------------------------
+
+    def start(self, name: str, parent: Union[Span, int, None] = None,
+              **attrs) -> Optional[Span]:
+        """Open a span WITHOUT pushing it on the thread's nesting stack —
+        the handle-passing form for spans that end on another thread
+        (e.g. a serve request: opened at ``submit()`` on the client
+        thread, finished by the dispatch thread at reply)."""
+        if not self.enabled:
+            return None
+        self._ring()  # bind tid/tname before reading them
+        span_id, trace_id, parent_id = self._lineage(parent)
+        return Span(name, self.clock_ns(), trace_id, span_id, parent_id,
+                    self._tls.tid, self._tls.tname, attrs)
+
+    def finish(self, span: Optional[Span], **attrs) -> None:
+        """Close ``span`` and retain it in the *finishing* thread's ring.
+        Idempotent — a second finish (e.g. the parts of a split request
+        sharing one root) updates attrs but does not re-append; ``None``
+        (from a disabled :meth:`start`) is a no-op."""
+        if span is None or not self.enabled:
+            return
+        if attrs:
+            span.attrs.update(attrs)
+        if span.t_end_ns != 0:
+            return
+        span.t_end_ns = self.clock_ns()
+        self._ring().append(span)
+
+    @contextlib.contextmanager
+    def span(self, name: str, parent: Union[Span, int, None] = None,
+             **attrs) -> Iterator[Optional[Span]]:
+        """RAII span, pushed on the thread's nesting stack so inner spans
+        auto-parent to it.  Exception-safe: the span finishes (and the
+        stack pops) even when the body raises, recording ``error=``."""
+        if not self.enabled:
+            yield None
+            return
+        sp = self.start(name, parent, **attrs)
+        stack = self._stack()
+        stack.append(sp)
+        try:
+            yield sp
+        except BaseException as exc:
+            sp.attrs["error"] = type(exc).__name__
+            raise
+        finally:
+            if stack and stack[-1] is sp:
+                stack.pop()
+            elif sp in stack:       # tolerate interleaved manual pops
+                stack.remove(sp)
+            self.finish(sp)
+
+    def record(self, name: str, t_start_ns: int, t_end_ns: int,
+               parent: Union[Span, int, None] = None,
+               **attrs) -> Optional[Span]:
+        """Retain an already-measured interval (post-hoc recording: the
+        caller timed a region under its own lock and records the span
+        after releasing it, keeping the recorder off the critical
+        section)."""
+        if not self.enabled:
+            return None
+        self._ring()
+        span_id, trace_id, parent_id = self._lineage(parent)
+        sp = Span(name, int(t_start_ns), trace_id, span_id, parent_id,
+                  self._tls.tid, self._tls.tname, attrs)
+        sp.t_end_ns = int(t_end_ns)
+        self._ring().append(sp)
+        return sp
+
+    def event(self, name: str, parent: Union[Span, int, None] = None,
+              **attrs) -> Optional[Span]:
+        """Zero-duration marker (a counted occurrence with context —
+        e.g. a gate fallback, a quarantined file)."""
+        if not self.enabled:
+            return None
+        now = self.clock_ns()
+        return self.record(name, now, now, parent, **attrs)
+
+    # -- draining -----------------------------------------------------------
+
+    def snapshot(self) -> List[Span]:
+        """Every retained span across all threads, oldest first (the
+        flight-recorder dump).  Never blocks recorders: rings are copied,
+        not locked."""
+        with self._lock:
+            rings = list(self._rings.values())
+        spans: List[Span] = []
+        for ring in rings:
+            spans.extend(ring.snapshot())
+        spans.sort(key=lambda s: (s.t_start_ns, s.span_id))
+        return spans
+
+    def clear(self) -> None:
+        """Drop retained spans (rings stay registered; open spans keep
+        their handles and will re-enter fresh rings on finish)."""
+        with self._lock:
+            for ring in self._rings.values():
+                ring.buf = []
+                ring.n = 0
+
+    def stats(self) -> dict:
+        """Recorder gauges: retained spans, total recorded, threads."""
+        with self._lock:
+            rings = list(self._rings.items())
+        return {
+            "threads": len(rings),
+            "retained": sum(len(r.buf) for _, r in rings),
+            "recorded": sum(r.n for _, r in rings),
+            "capacity_per_thread": self.capacity_per_thread,
+            "enabled": self.enabled,
+        }
+
+
+_default: Optional[SpanRecorder] = None
+_default_lock = threading.Lock()
+
+
+def recorder() -> SpanRecorder:
+    """The process-wide flight recorder (created on first use;
+    ``RAFT_OBS_SPANS=0`` starts it disabled, ``RAFT_OBS_RING`` sizes the
+    per-thread rings, default 4096)."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = SpanRecorder(
+                int(os.environ.get("RAFT_OBS_RING", "4096")),
+                enabled=os.environ.get("RAFT_OBS_SPANS", "1") != "0")
+        return _default
+
+
+def set_recorder(rec: SpanRecorder) -> SpanRecorder:
+    """Swap the process-wide recorder (tests; embedding hosts that own
+    their telemetry wiring).  Returns the previous one."""
+    global _default
+    with _default_lock:
+        prev, _default = _default, rec
+        return prev if prev is not None else rec

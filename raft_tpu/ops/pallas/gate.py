@@ -8,8 +8,14 @@ expected to pre-check the artifact — which fails exactly in the live
 failure mode (BENCH_r04/r05): a wedged TPU tunnel where the platform
 probe hangs, or a stale artifact from an older kernel source tree.
 
-The gate centralizes three decisions, each with a *logged reason* so a
-fallback is observable instead of silent:
+The gate centralizes three decisions, each with a *logged reason* AND a
+counted event so a fallback is observable instead of silent — every
+non-mosaic resolution a TPU caller would care about increments
+``raft_pallas_gate_fallback_total{kernel,reason}`` in the process-global
+:func:`raft_tpu.obs.registry` (scraped via any server's
+``prometheus_text()``) and drops a marker event into the flight
+recorder, so fleet dashboards can alert on "replicas silently serving
+from stock XLA" without grepping logs:
 
 * :func:`probe_backend` — ``jax.default_backend()`` behind a daemon-thread
   timeout (``RAFT_PLATFORM_PROBE_TIMEOUT`` seconds, default 60).  A wedged
@@ -64,6 +70,40 @@ def _log_once(key: str, msg: str, *args) -> None:
     default_logger().warning(msg, *args)
 
 
+#: reason-string prefix -> the label value the fallback counter carries
+#: (free-text reasons stay in logs/events; labels must be low-cardinality)
+_REASON_CLASSES = (
+    ("platform probe", "probe_wedged"),
+    ("backend is", "backend_not_tpu"),
+    ("missing", "artifact_missing"),
+    ("unreadable", "artifact_unreadable"),
+    ("stamp, not a", "artifact_not_hardware"),
+    ("failed checks", "artifact_failed_checks"),
+    ("stale", "artifact_stale"),
+)
+
+
+def _reason_class(reason: str) -> str:
+    for needle, cls in _REASON_CLASSES:
+        if needle in reason:
+            return cls
+    return "other"
+
+
+def _count_fallback(kernel: str, reason: str) -> None:
+    """A gate-closed resolution is a *counted event*, not just a log
+    line: labelled counter in the global registry + flight-recorder
+    marker carrying the full free-text reason."""
+    from ...obs.metrics import registry
+    from ...obs.spans import recorder
+
+    registry().counter(
+        "raft_pallas_gate_fallback_total",
+        "Pallas dispatches resolved to stock XLA with the gate closed",
+    ).inc(kernel=kernel, reason=_reason_class(reason))
+    recorder().event("pallas.gate_fallback", kernel=kernel, reason=reason)
+
+
 def probe_backend(timeout_s: Optional[float] = None) -> Optional[str]:
     """``jax.default_backend()`` that cannot wedge the caller.
 
@@ -93,6 +133,12 @@ def probe_backend(timeout_s: Optional[float] = None) -> Optional[str]:
     t.join(timeout_s)
     backend = result.get("backend")
     if backend is None:
+        from ...obs.metrics import registry
+
+        registry().counter(
+            "raft_pallas_probe_failures_total",
+            "platform probes that wedged or raised (BENCH_r04/r05 mode)",
+        ).inc(outcome="raised" if "error" in result else "timeout")
         _log_once("probe", "platform probe %s after %.0fs — treating the "
                   "backend as unavailable; Pallas dispatch falls back to "
                   "stock XLA paths",
@@ -165,12 +211,14 @@ def dispatch_mode(kernel: str) -> str:
     backend = probe_backend()
     if backend is None:
         mode = "xla"  # reason already logged by the probe
+        _count_fallback(kernel, "platform probe wedged or failed")
     elif backend != "tpu":
-        mode = "interpret"
+        mode = "interpret"   # off-TPU parity mode is normal, not a fallback
     else:
         ok, reason = mosaic_gate(kernel)
         mode = "mosaic" if ok else "xla"
         if not ok:
+            _count_fallback(kernel, reason)
             _log_once(f"gate:{kernel}",
                       "Mosaic gate closed for %s (%s); falling back to the "
                       "stock XLA path", kernel, reason)

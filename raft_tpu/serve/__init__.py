@@ -56,10 +56,11 @@ from .cache import ExecutableCache
 from .compaction import CompactionPolicy, CompactionScheduler
 from .faults import (CRASH_EXIT_CODE, TRANSIENT_FAULTS, DeviceOOM, FaultError,
                      FaultInjector, SwapFailed, WedgedDevice)
-from .metrics import ServingMetrics
+from .metrics import ServingMetrics, UnknownCounter
 from .registry import Generation, IndexRegistry
 from .searchers import family_of, make_searcher, unwrap_tombstones
 from .server import SearchServer, ServerConfig
+from ..obs.watchdog import StallWatchdog
 
 __all__ = [
     "SearchServer",
@@ -70,6 +71,8 @@ __all__ = [
     "CRASH_EXIT_CODE",
     "ExecutableCache",
     "ServingMetrics",
+    "StallWatchdog",
+    "UnknownCounter",
     "AdmissionPolicy",
     "AdmissionController",
     "RetryPolicy",

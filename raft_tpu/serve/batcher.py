@@ -70,6 +70,7 @@ class Request:
     future: object = None        # set for unsplit requests
     sink: Optional[SplitSink] = None   # set for split parts
     part: int = 0
+    span: object = None          # obs root span (serve.request), if recording
 
     @property
     def rows(self) -> int:

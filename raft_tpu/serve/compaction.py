@@ -158,12 +158,16 @@ class CompactionScheduler:
         metrics = self.server.metrics
         metrics.count("compactions_scheduled")
         self._last_run = self.clock() if now is None else now
-        try:
-            self.server.swap_index(build=self._build)
-        except Exception as exc:  # noqa: BLE001 — background loop survives
-            metrics.count("compactions_failed")
-            self.last_error = exc
-            return None
+        with self.server.recorder.span("serve.compaction",
+                                       trigger=reason) as sp:
+            try:
+                self.server.swap_index(build=self._build)
+            except Exception as exc:  # noqa: BLE001 — background loop survives
+                metrics.count("compactions_failed")
+                self.last_error = exc
+                if sp is not None:
+                    sp.attrs["status"] = "failed"
+                return None
         metrics.count("compactions_completed")
         self.last_error = None
         return reason

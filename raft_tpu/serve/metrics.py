@@ -1,11 +1,24 @@
-"""Serving metrics — counters, latency percentiles, JSON export.
+"""Serving metrics — registry-backed counters, histograms, JSON export.
 
 One :class:`ServingMetrics` per server: submit/reject/timeout counters,
 batch shape accounting (fill ratio = real rows / padded rows, the
-padding-waste signal that tunes the bucket ladder), a bounded latency
-reservoir for p50/p95/p99, and per-level degradation dispatch counts.
-``snapshot()`` is the JSON schema documented in
-``docs/serving_guide.md`` and consumed by ``bench/serve.py``.
+padding-waste signal that tunes the bucket ladder), latency tracked BOTH
+ways — a bounded reservoir for exact window p50/p95/p99 (the historical
+JSON schema) and a fixed-boundary :class:`raft_tpu.obs.Histogram` whose
+bucket counts are mergeable across replicas (the pod-scale story the
+reservoir cannot serve) — and per-level degradation dispatch counts.
+
+Every counter lives in a per-server :class:`raft_tpu.obs.MetricRegistry`
+(ISSUE 9): ``snapshot()`` keeps the exact ``docs/serving_guide.md`` JSON
+schema, and :meth:`prometheus_text` renders the same registry (plus the
+process-global one, which carries Pallas gate fallbacks and tracing
+diagnostics) as Prometheus text exposition.
+
+``count()`` accepts **registered names only** and raises
+:class:`UnknownCounter` otherwise — a typo'd counter name used to
+surface as a confusing ``AttributeError`` deep in ``setattr`` math.
+Subsystems with genuinely new counters declare them first with
+:meth:`ServingMetrics.declare` (the documented dynamic-create path).
 """
 
 from __future__ import annotations
@@ -14,8 +27,17 @@ import json
 import math
 import threading
 from collections import deque
+from typing import Optional, Sequence
 
-__all__ = ["ServingMetrics", "percentile"]
+from ..obs.metrics import (DEFAULT_LATENCY_BOUNDARIES_MS, MetricRegistry)
+
+__all__ = ["ServingMetrics", "UnknownCounter", "percentile"]
+
+
+class UnknownCounter(KeyError):
+    """``count()`` was called with a name no one registered — almost
+    always a typo; use :meth:`ServingMetrics.declare` for intentional
+    dynamic counters."""
 
 
 def percentile(sorted_vals, q: float) -> float:
@@ -27,101 +49,171 @@ def percentile(sorted_vals, q: float) -> float:
     return float(sorted_vals[rank])
 
 
-class ServingMetrics:
-    """Thread-safe counters + bounded latency reservoir."""
+#: (field, help) — the registered counter set; the field name is both the
+#: ``count()`` key and the ``snapshot()`` JSON key, the Prometheus name is
+#: ``raft_serve_<field>_total``.
+COUNTER_SPECS = (
+    ("submitted", "requests accepted into the queue"),
+    ("completed", "requests answered"),
+    ("rejected_queue_full", "submits refused at queue capacity"),
+    ("rejected_deadline", "requests expired while queued, never dispatched"),
+    ("late_completions", "requests answered past their deadline"),
+    ("batches", "accelerator dispatches"),
+    ("real_rows", "query rows carried by requests"),
+    ("padded_rows", "bucket rows dispatched (>= real_rows)"),
+    ("swaps", "generation handoffs completed"),
+    ("failed_swaps", "swaps rolled back (old generation kept)"),
+    ("retries", "dispatch retries after transient faults"),
+    ("faulted_batches", "batches rejected with retries exhausted"),
+    ("stalls", "wedged dispatches detected by the stall watchdog"),
+    ("wal_appends", "durable mutations logged (neighbors.wal)"),
+    ("wal_replayed", "WAL records replayed during recovery"),
+    ("snapshots", "crash-consistent snapshots published"),
+    ("quarantined_files", "corrupt artifacts renamed aside"),
+    ("recoveries", "DurableStore.recover completions"),
+    ("compactions_scheduled", "scheduler trigger firings"),
+    ("compactions_completed", "compaction + swap succeeded"),
+    ("compactions_failed", "compaction attempts rolled back"),
+)
 
-    def __init__(self, latency_window: int = 4096) -> None:
+
+class ServingMetrics:
+    """Thread-safe registry-backed counters + latency reservoir +
+    mergeable latency histogram.
+
+    Registered counters read as attributes (``metrics.completed``) for
+    backward compatibility with the flat-field era; ``registry`` is the
+    per-server :class:`~raft_tpu.obs.MetricRegistry` the Prometheus
+    exposition renders."""
+
+    def __init__(self, latency_window: int = 4096, *,
+                 registry: Optional[MetricRegistry] = None,
+                 latency_boundaries_ms: Sequence[float] =
+                 DEFAULT_LATENCY_BOUNDARIES_MS) -> None:
         self._lock = threading.Lock()
         self._lat_ms = deque(maxlen=int(latency_window))
-        self.submitted = 0           # requests accepted into the queue
-        self.completed = 0           # requests answered
-        self.rejected_queue_full = 0
-        self.rejected_deadline = 0   # expired while queued, never dispatched
-        self.late_completions = 0    # answered, but past their deadline
-        self.batches = 0
-        self.real_rows = 0           # query rows carried by requests
-        self.padded_rows = 0         # bucket rows dispatched (>= real_rows)
-        self.swaps = 0               # generation handoffs completed
-        self.failed_swaps = 0        # swaps rolled back (old gen kept)
-        self.retries = 0             # dispatch retries after transient faults
-        self.faulted_batches = 0     # batches rejected with retries exhausted
-        self.wal_appends = 0         # durable mutations logged (neighbors.wal)
-        self.wal_replayed = 0        # WAL records replayed during recovery
-        self.snapshots = 0           # crash-consistent snapshots published
-        self.quarantined_files = 0   # corrupt artifacts renamed aside
-        self.recoveries = 0          # DurableStore.recover completions
-        self.compactions_scheduled = 0  # scheduler trigger firings
-        self.compactions_completed = 0  # compaction + swap succeeded
-        self.compactions_failed = 0     # compaction attempts rolled back
-        self.degrade_dispatches: dict = {}  # level -> batch count
+        self.registry = registry if registry is not None else MetricRegistry()
+        self._counters = {}
+        for field, help_ in COUNTER_SPECS:
+            self._counters[field] = self.registry.counter(
+                f"raft_serve_{field}_total", help_)
+        self.latency_hist = self.registry.histogram(
+            "raft_serve_latency_ms",
+            "request latency, submit to reply (fixed mergeable buckets)",
+            latency_boundaries_ms)
+        self._degrade = self.registry.counter(
+            "raft_serve_degrade_dispatches_total",
+            "batches dispatched per degradation level")
+
+    # -- counters -----------------------------------------------------------
+
+    def declare(self, field: str, help: str = "") -> None:
+        """Register a new counter at runtime (the documented
+        dynamic-create path — e.g. an embedding host's custom serve
+        counter).  Idempotent; the field then works with :meth:`count`,
+        attribute reads, ``snapshot()`` and the Prometheus exposition."""
+        with self._lock:
+            if field not in self._counters:
+                self._counters[field] = self.registry.counter(
+                    f"raft_serve_{field}_total", help)
 
     def count(self, field: str, n: int = 1) -> None:
-        with self._lock:
-            setattr(self, field, getattr(self, field) + n)
+        c = self._counters.get(field)
+        if c is None:
+            raise UnknownCounter(
+                f"unknown serving counter {field!r} — registered: "
+                f"{sorted(self._counters)}; use declare({field!r}) first "
+                "for an intentional new counter")
+        c.inc(n)
+
+    def counter_value(self, field: str) -> int:
+        c = self._counters.get(field)
+        if c is None:
+            raise UnknownCounter(f"unknown serving counter {field!r}")
+        return int(c.value())
+
+    def __getattr__(self, name: str):
+        # only reached when normal attribute lookup fails: registered
+        # counters read as plain ints (the flat-field era API)
+        counters = self.__dict__.get("_counters")
+        if counters is not None and name in counters:
+            return int(counters[name].value())
+        raise AttributeError(
+            f"{type(self).__name__!r} object has no attribute {name!r}")
+
+    # -- observations -------------------------------------------------------
 
     def observe_batch(self, bucket: int, rows: int, level: int) -> None:
-        with self._lock:
-            self.batches += 1
-            self.real_rows += int(rows)
-            self.padded_rows += int(bucket)
-            self.degrade_dispatches[level] = \
-                self.degrade_dispatches.get(level, 0) + 1
+        self._counters["batches"].inc()
+        self._counters["real_rows"].inc(int(rows))
+        self._counters["padded_rows"].inc(int(bucket))
+        self._degrade.inc(level=str(int(level)))
 
     def observe_latency(self, ms: float, late: bool = False) -> None:
+        self._counters["completed"].inc()
+        self.latency_hist.observe(float(ms))
         with self._lock:
-            self.completed += 1
             self._lat_ms.append(float(ms))
-            if late:
-                self.late_completions += 1
+        if late:
+            self._counters["late_completions"].inc()
+
+    # -- export -------------------------------------------------------------
+
+    @property
+    def degrade_dispatches(self) -> dict:
+        """``{level: batch count}`` — derived from the labelled counter."""
+        return {int(labels["level"]): int(v)
+                for labels, v in self._degrade.samples()}
 
     def snapshot(self) -> dict:
-        """Point-in-time metrics dict (the serving-guide JSON schema)."""
+        """Point-in-time metrics dict (the serving-guide JSON schema,
+        backward-compatible) + the mergeable ``latency_hist`` block."""
         with self._lock:
             lat = sorted(self._lat_ms)
-            fill = (self.real_rows / self.padded_rows
-                    if self.padded_rows else 0.0)
-            return {
-                "submitted": self.submitted,
-                "completed": self.completed,
-                "rejected_queue_full": self.rejected_queue_full,
-                "rejected_deadline": self.rejected_deadline,
-                "late_completions": self.late_completions,
-                "batches": self.batches,
-                "real_rows": self.real_rows,
-                "padded_rows": self.padded_rows,
-                "swaps": self.swaps,
-                "failed_swaps": self.failed_swaps,
-                "retries": self.retries,
-                "faulted_batches": self.faulted_batches,
-                "wal_appends": self.wal_appends,
-                "wal_replayed": self.wal_replayed,
-                "snapshots": self.snapshots,
-                "quarantined_files": self.quarantined_files,
-                "recoveries": self.recoveries,
-                "compactions_scheduled": self.compactions_scheduled,
-                "compactions_completed": self.compactions_completed,
-                "compactions_failed": self.compactions_failed,
-                "batch_fill_ratio": round(fill, 4),
-                "degrade_dispatches": {str(k): v for k, v in
-                                       sorted(self.degrade_dispatches.items())},
-                "latency_ms": {
-                    "count": len(lat),
-                    "p50": round(percentile(lat, 50), 3),
-                    "p95": round(percentile(lat, 95), 3),
-                    "p99": round(percentile(lat, 99), 3),
-                    "max": round(lat[-1], 3) if lat else 0.0,
-                },
-            }
+        snap = {field: int(c.value()) for field, c in self._counters.items()}
+        fill = (snap["real_rows"] / snap["padded_rows"]
+                if snap["padded_rows"] else 0.0)
+        hist = self.latency_hist.samples()
+        counts, total = (hist[0][1], hist[0][2]) if hist else ([], 0.0)
+        snap.update({
+            "batch_fill_ratio": round(fill, 4),
+            "degrade_dispatches": {str(k): v for k, v in
+                                   sorted(self.degrade_dispatches.items())},
+            "latency_ms": {
+                "count": len(lat),
+                "p50": round(percentile(lat, 50), 3),
+                "p95": round(percentile(lat, 95), 3),
+                "p99": round(percentile(lat, 99), 3),
+                "max": round(lat[-1], 3) if lat else 0.0,
+            },
+            "latency_hist": {
+                "boundaries_ms": list(self.latency_hist.boundaries),
+                "counts": list(counts),
+                "sum_ms": round(float(total), 3),
+            },
+        })
+        return snap
+
+    def prometheus_text(self, extra_registries: Sequence = ()) -> str:
+        """Prometheus text exposition of this server's registry, any
+        ``extra_registries``, and the process-global one (gate fallbacks,
+        tracing diagnostics) — one scrape body for the whole process."""
+        from ..obs.metrics import registry as global_registry
+        from ..obs.prometheus import render
+
+        return render((self.registry, *extra_registries, global_registry()))
 
     def to_json(self, path=None, extra=None) -> str:
         """Serialize ``snapshot()`` (+ optional extra keys, e.g. cache
         counters and queue depth from the server) to JSON; write to
-        ``path`` when given."""
+        ``path`` when given (atomically — a mid-write crash never leaves
+        a torn metrics file)."""
         snap = self.snapshot()
         if extra:
             snap.update(extra)
         text = json.dumps(snap, indent=2, sort_keys=True)
         if path:
-            with open(path, "w") as f:
-                f.write(text + "\n")
+            from ..core.serialize import write_text_atomic
+
+            write_text_atomic(path, text + "\n")
         return text

@@ -27,6 +27,7 @@ import numpy as np
 from ..core import tracing
 from ..core.errors import expects
 from ..core.logging import default_logger
+from ..obs import spans as obs_spans
 from .admission import (AdmissionController, AdmissionPolicy,
                         DeadlineExceeded, QueueFull, RetryPolicy,
                         ServeError)
@@ -103,7 +104,7 @@ class SearchServer:
                  config: Optional[ServerConfig] = None,
                  clock=time.monotonic, seed: int = 0, res=None,
                  faults: Optional[FaultInjector] = None,
-                 sleep=time.sleep) -> None:
+                 sleep=time.sleep, recorder=None) -> None:
         self._registry = IndexRegistry(index)
         self.family = family_of(index)
         expects(1 <= k <= index_size(index),
@@ -129,6 +130,11 @@ class SearchServer:
         # exactly; distinct replicas pass distinct seeds to decorrelate
         self._retry_rng = random.Random(self.seed ^ 0x9E3779B9)
         self.durable_store = None  # neighbors.wal.DurableStore, if adopted
+        # flight recorder: the process-wide ring unless the caller wires
+        # its own (tests; multi-server hosts separating evidence)
+        self.recorder = recorder if recorder is not None \
+            else obs_spans.recorder()
+        self._inflight = None      # (site, t0) while a dispatch is on-device
         self._log = default_logger() if res is None else None
         self._cond = threading.Condition()
         self._parts_lock = threading.Lock()
@@ -252,25 +258,42 @@ class SearchServer:
         deadline = self.admission.deadline(now, deadline_ms)
         future: Future = Future()
         parts = split_rows(q.shape[0], self.ladder[-1])
+        # the request's root span: opened here on the client thread,
+        # finished by whichever thread resolves/rejects it — every later
+        # lifecycle span (enqueue/batch-form/dispatch/device-exec/reply)
+        # parents under it, forming one connected tree per request
+        root = self.recorder.start("serve.request", rows=int(q.shape[0]),
+                                   k=kk, parts=len(parts))
+        t_enq = self.recorder.clock_ns() if root is not None else 0
+        rejected_depth = None
         with self._cond:
             if not self.admission.admit(len(self._pending) + len(parts) - 1):
-                self.metrics.count("rejected_queue_full")
-                raise QueueFull(
-                    f"queue at capacity ({self.admission.policy.max_queue});"
-                    " retry with backoff or raise max_queue")
-            if len(parts) == 1:
-                self._pending.append(Request(q, kk, deadline, now,
-                                             future=future))
+                rejected_depth = len(self._pending)
             else:
-                sink = SplitSink(future, len(parts))
-                lo = 0
-                for i, rows in enumerate(parts):
-                    self._pending.append(Request(q[lo:lo + rows], kk,
-                                                 deadline, now, sink=sink,
-                                                 part=i))
-                    lo += rows
-            self.metrics.count("submitted")
-            self._cond.notify_all()
+                if len(parts) == 1:
+                    self._pending.append(Request(q, kk, deadline, now,
+                                                 future=future, span=root))
+                else:
+                    sink = SplitSink(future, len(parts))
+                    lo = 0
+                    for i, rows in enumerate(parts):
+                        self._pending.append(Request(q[lo:lo + rows], kk,
+                                                     deadline, now, sink=sink,
+                                                     part=i, span=root))
+                        lo += rows
+                self.metrics.count("submitted")
+                self._cond.notify_all()
+        if rejected_depth is not None:
+            self.metrics.count("rejected_queue_full")
+            self.recorder.finish(root, status="rejected_queue_full",
+                                 queue_depth=rejected_depth)
+            raise QueueFull(
+                f"queue at capacity ({self.admission.policy.max_queue});"
+                " retry with backoff or raise max_queue")
+        if root is not None:
+            self.recorder.record("serve.enqueue", t_enq,
+                                 self.recorder.clock_ns(), parent=root,
+                                 deadline_ms=round(1e3 * (deadline - now), 3))
         return future
 
     def search(self, queries, k: Optional[int] = None,
@@ -295,6 +318,7 @@ class SearchServer:
         the accelerator."""
         if now is None:
             now = self.clock()
+        t_plan = self.recorder.clock_ns() if self.recorder.enabled else 0
         with self._cond:
             expired = [r for r in self._pending if r.deadline < now]
             if expired:
@@ -310,11 +334,19 @@ class SearchServer:
                                  if id(r) not in chosen]
         for req in expired:
             self.metrics.count("rejected_deadline")
+            self.recorder.finish(req.span, status="rejected_deadline")
             req.reject(DeadlineExceeded(
                 f"deadline passed {1e3 * (now - req.deadline):.1f}ms before"
                 " dispatch (queue wait exceeded the budget)"))
         if batch is None:
             return len(expired)
+        if self.recorder.enabled:
+            # post-hoc: planning ran under the queue lock; the span is
+            # recorded after release, parented to the batch head's request
+            self.recorder.record("serve.batch_form", t_plan,
+                                 self.recorder.clock_ns(),
+                                 parent=batch[0].span,
+                                 n_requests=len(batch), queue_depth=depth)
         level = min(self.admission.level(depth),
                     len(self.config.degrade_effort_scales) - 1)
         self._execute(batch, bucket, level)
@@ -369,54 +401,92 @@ class SearchServer:
         retry = self.config.retry
         backoffs = retry.start(self._retry_rng)
         attempt = 0
-        while True:
-            try:
-                self.faults.fire("execute")
-                compiled, operands = self._compiled(bucket, batch[0].k,
-                                                    qpad.dtype, level)
-                with tracing.range("serve.dispatch(%s,b=%d,k=%d,lvl=%d)",
-                                   self.family, bucket, batch[0].k, level):
-                    # explicit transfers at the serving boundary: device_put /
-                    # device_get pass ``jax.transfer_guard("disallow")``, so a
-                    # TraceGuard-wrapped serve loop proves these are the ONLY
-                    # host<->device crossings on the path
-                    d, i = compiled(jax.device_put(qpad), *operands)
-                    d, i = jax.device_get((d, i))  # host fetch = completion barrier
-                    d = np.asarray(d)
-                    i = np.asarray(i)
-                break
-            except TRANSIENT_FAULTS as exc:
-                attempt += 1
-                backoff = backoffs.next_s()
-                earliest = min(r.deadline for r in batch)
-                if attempt > retry.max_retries:
-                    self.metrics.count("faulted_batches")
+        # dispatch span: parented to the batch head's request (the other
+        # requests are linked through `request_spans`); the in-flight
+        # marker is what the stall watchdog polls — it stays set through
+        # retries, so a wedge that burns backoff still reads as ONE stall
+        dispatch = self.recorder.start(
+            "serve.dispatch", parent=batch[0].span, bucket=int(bucket),
+            level=int(level), n_requests=len(batch),
+            request_spans=[r.span.span_id for r in batch
+                           if r.span is not None])
+        self._inflight = ("execute", self.clock())
+        try:
+            while True:
+                try:
+                    self.faults.fire("execute")
+                    compiled, operands = self._compiled(bucket, batch[0].k,
+                                                        qpad.dtype, level)
+                    with self.recorder.span("serve.device_exec",
+                                            parent=dispatch,
+                                            attempt=attempt), \
+                            tracing.range(
+                                "serve.dispatch(%s,b=%d,k=%d,lvl=%d)",
+                                self.family, bucket, batch[0].k, level):
+                        # explicit transfers at the serving boundary:
+                        # device_put / device_get pass
+                        # ``jax.transfer_guard("disallow")``, so a
+                        # TraceGuard-wrapped serve loop proves these are the
+                        # ONLY host<->device crossings on the path
+                        d, i = compiled(jax.device_put(qpad), *operands)
+                        d, i = jax.device_get((d, i))  # host fetch = completion barrier
+                        d = np.asarray(d)
+                        i = np.asarray(i)
+                    break
+                except TRANSIENT_FAULTS as exc:
+                    attempt += 1
+                    backoff = backoffs.next_s()
+                    earliest = min(r.deadline for r in batch)
+                    if attempt > retry.max_retries:
+                        self.metrics.count("faulted_batches")
+                        self.recorder.finish(dispatch, status="faulted",
+                                             error=type(exc).__name__)
+                        for req in batch:
+                            self.recorder.finish(req.span, status="faulted")
+                            req.reject(exc)
+                        return
+                    if self.clock() + backoff > earliest:
+                        # deadline-aware retry budget: don't burn backoff on
+                        # answers nobody will be waiting for
+                        self.metrics.count("faulted_batches")
+                        err = DeadlineExceeded(
+                            f"transient fault ({exc!r}) and the next "
+                            f"{1e3 * backoff:.1f}ms backoff outlives the "
+                            "batch deadline")
+                        self.recorder.finish(dispatch, status="faulted",
+                                             error=type(exc).__name__)
+                        for req in batch:
+                            self.recorder.finish(req.span, status="faulted")
+                            req.reject(err)
+                        return
+                    self.metrics.count("retries")
+                    self.recorder.event("serve.retry", parent=dispatch,
+                                        attempt=attempt,
+                                        backoff_ms=round(1e3 * backoff, 3),
+                                        error=type(exc).__name__)
+                    self._sleep(backoff)
+                except Exception as exc:  # noqa: BLE001 — fail the batch, not the server
+                    self.recorder.finish(dispatch, status="error",
+                                         error=type(exc).__name__)
                     for req in batch:
-                        req.reject(exc)
-                    return
-                if self.clock() + backoff > earliest:
-                    # deadline-aware retry budget: don't burn backoff on
-                    # answers nobody will be waiting for
-                    self.metrics.count("faulted_batches")
-                    err = DeadlineExceeded(
-                        f"transient fault ({exc!r}) and the next "
-                        f"{1e3 * backoff:.1f}ms backoff outlives the batch "
-                        "deadline")
-                    for req in batch:
-                        req.reject(err)
-                    return
-                self.metrics.count("retries")
-                self._sleep(backoff)
-            except Exception as exc:  # noqa: BLE001 — fail the batch, not the server
-                for req in batch:
-                    req.reject(ServeError(f"dispatch failed: {exc!r}"))
-                raise
+                        self.recorder.finish(req.span, status="error")
+                        req.reject(ServeError(f"dispatch failed: {exc!r}"))
+                    raise
+        finally:
+            self._inflight = None
+        self.recorder.finish(dispatch, status="ok", attempts=attempt + 1)
         done = self.clock()
         self.metrics.observe_batch(bucket, rows, level)
         lo = 0
         for req in batch:
             hi = lo + req.rows
+            reply_ns = self.recorder.clock_ns() if self.recorder.enabled else 0
             req.resolve(d[lo:hi], i[lo:hi])
+            if req.span is not None:
+                self.recorder.record("serve.reply", reply_ns,
+                                     self.recorder.clock_ns(),
+                                     parent=req.span, part=req.part)
+                self.recorder.finish(req.span, status="ok")
             self.metrics.observe_latency(1e3 * (done - req.t_submit),
                                          late=done > req.deadline)
             lo = hi
@@ -520,6 +590,56 @@ class SearchServer:
 
     # -- observability ------------------------------------------------------
 
+    def dispatch_inflight(self):
+        """``(site, t0)`` while a dispatch is executing on-device (server
+        clock seconds), else ``None`` — the marker
+        :class:`raft_tpu.obs.StallWatchdog` polls for the wedge failure
+        mode.  Reads are lock-free: a Python tuple swap is atomic."""
+        return self._inflight
+
+    def attach_watchdog(self, quarantine_dir, **kw):
+        """Construct (NOT start) a :class:`raft_tpu.obs.StallWatchdog`
+        over this server's dispatch marker, flight recorder and metrics;
+        kwargs forward (``stall_timeout_s``, ``poll_interval_s``,
+        ``capture_s``...).  Call ``.start()`` on the result, or drive
+        ``.check()`` inline in deterministic tests."""
+        from ..obs.watchdog import StallWatchdog
+
+        kw.setdefault("recorder", self.recorder)
+        return StallWatchdog(self, quarantine_dir, **kw)
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition for a scrape handler: the serving
+        counters/histogram plus live gauges (queue depth/rows, degrade
+        level, executable-cache and flight-recorder occupancy) and the
+        process-global registry (Pallas gate fallbacks etc.)."""
+        with self._cond:
+            depth = len(self._pending)
+            qrows = sum(r.rows for r in self._pending)
+        reg = self.metrics.registry
+        reg.gauge("raft_serve_queue_depth",
+                  "requests waiting in the queue").set(depth)
+        reg.gauge("raft_serve_queue_rows",
+                  "query rows waiting in the queue").set(qrows)
+        reg.gauge("raft_serve_degrade_level",
+                  "current admission degradation level").set(
+                      self.admission.level(depth))
+        reg.gauge("raft_serve_generation",
+                  "serving index generation").set(self._registry.gen_id)
+        reg.gauge("raft_serve_index_rows",
+                  "rows in the serving generation").set(
+                      index_size(self.index))
+        cache = self.cache.snapshot()
+        reg.gauge("raft_serve_cache_hits", "executable cache hits").set(
+            cache.get("hits", 0))
+        reg.gauge("raft_serve_cache_compiles",
+                  "executable cache compiles").set(cache.get("compiles", 0))
+        rec = self.recorder.stats()
+        reg.gauge("raft_obs_flight_recorder_spans",
+                  "spans retained in the flight recorder").set(
+                      rec["retained"])
+        return self.metrics.prometheus_text()
+
     def metrics_snapshot(self) -> dict:
         """Serving metrics + live gauges + compile-cache counters (the
         ``docs/serving_guide.md`` schema)."""
@@ -532,6 +652,7 @@ class SearchServer:
             "queue_rows": qrows,
             "degrade_level": self.admission.level(depth),
             "cache": self.cache.snapshot(),
+            "obs": self.recorder.stats(),
             "server": {"family": self.family, "k": self.k,
                        "ladder": list(self.ladder),
                        "index_rows": index_size(self.index),
@@ -544,11 +665,15 @@ class SearchServer:
 
     def dump_metrics(self, path=None) -> str:
         """JSON-serialize :meth:`metrics_snapshot` (optionally to a
-        file) — the bench harness's ingestion format."""
+        file) — the bench harness's ingestion format.  File writes use
+        the ``core/serialize`` temp + fsync + atomic-rename discipline:
+        a crash mid-dump leaves the previous complete file, never a torn
+        one."""
         import json
 
         text = json.dumps(self.metrics_snapshot(), indent=2, sort_keys=True)
         if path:
-            with open(path, "w") as f:
-                f.write(text + "\n")
+            from ..core.serialize import write_text_atomic
+
+            write_text_atomic(path, text + "\n")
         return text

@@ -1,0 +1,112 @@
+#!/bin/bash
+# Round-12 TPU job queue: first hardware round for the unified telemetry
+# subsystem (raft_tpu.obs — ISSUE 9).
+#   * mosaic re-stamps bench/MOSAIC_CHECK.json first, as always: the
+#     dispatch gate rejects stale kernel_sha stamps, and every gate
+#     fallback is now a COUNTED event
+#     (raft_pallas_gate_fallback_total{kernel,reason}) — after this
+#     round the scrape body is where "replica silently on stock XLA"
+#     shows up, so the stamp must be fresh before anything dispatches.
+#   * obs_watchdog — the stall-watchdog smoke on real hardware: a serve
+#     loop with an injected wedge must trip StallWatchdog, leave a
+#     stall-*/ dump (flight recorder + metrics + jax.profiler capture
+#     with capture_s > 0 — the CPU tier runs capture_s=0) and keep
+#     answering.  This is the BENCH_r04/r05 failure mode finally
+#     producing evidence instead of a bench timeout.
+#   * obs_overhead — bench/obs_overhead.py on TPU: spans-on vs spans-off
+#     per-request cost, hardware counterpart of the committed
+#     bench/OBS_OVERHEAD_CPU.json.
+# Stage order: jaxlint -> mosaic -> watchdog smoke -> obs overhead ->
+# serve bench -> bench.py.
+# Markers stay in /tmp/tpu_jobs_r3 so steps completed by earlier rounds'
+# queues are not repeated.
+set -u
+cd /root/repo || exit 1
+LOG=/tmp/tpu_jobs_r3
+mkdir -p "$LOG"
+. "$(dirname "$0")/tpu_queue_lib.sh"
+acquire_queue_lock tpu_jobs_r12
+export RAFT_BENCH_CKPT_DIR="$LOG/bench_ckpt"
+
+echo "$(date) [r12 queue] waiting for TPU..." >> "$LOG/driver.log"
+wait_probe
+echo "$(date) TPU is back" >> "$LOG/driver.log"
+
+run_step() {  # name, timeout_s, command...   (two attempts, gated .done)
+  local name=$1 tmo=$2; shift 2
+  [ -f "$LOG/$name.done" ] && return 0
+  local attempt
+  for attempt in 1 2; do
+    echo "$(date) start $name (attempt $attempt)" >> "$LOG/driver.log"
+    timeout "$tmo" "$@" > "$LOG/$name.$attempt.log" 2>&1 9<&-
+    rc=$?
+    cp -f "$LOG/$name.$attempt.log" "$LOG/$name.log"  # latest = canonical
+    if [ "$rc" -eq 0 ]; then
+      if [ "$name" != bench ] || bench_measured "$LOG/$name.log" brute_force; then
+        touch "$LOG/$name.done"
+        echo "$(date) done $name" >> "$LOG/driver.log"
+        return 0
+      fi
+      echo "$(date) $name exited 0 with no headline measurement (wedged backend)" \
+        >> "$LOG/driver.log"
+    else
+      echo "$(date) FAILED $name (rc=$rc)" >> "$LOG/driver.log"
+    fi
+    # a killed/wedged client can poison the tunnel for the next step too:
+    # re-probe before the retry (or before handing on to the next step)
+    wait_probe
+  done
+}
+
+# jaxlint first: pure-host AST pass (now covers raft_tpu/obs), zero chip time
+run_step jaxlint_r12    300 python scripts/mini_lint.py --jax raft_tpu --stats-json bench/JAXLINT.json
+# mosaic BEFORE anything that dispatches Pallas: re-validates the kernels
+# on hardware and stamps the sha-scoped artifact the dispatch gate needs
+run_step mosaic         900 env RAFT_MOSAIC_REQUIRE_TPU=1 python scripts/mosaic_check.py
+# stall-watchdog smoke + profiler capture: wedge-fault a serve loop on
+# hardware, require a stall dump with a non-empty profile/ capture
+# (written to a file first: run_step retries must not re-read stdin)
+cat > "$LOG/obs_watchdog_smoke.py" <<'PY'
+import glob, json, os, sys, tempfile
+
+sys.path.insert(0, os.getcwd())        # the queue runs this from /root/repo
+
+import numpy as np
+from raft_tpu.obs import SpanRecorder
+from raft_tpu.serve import (FaultInjector, RetryPolicy, SearchServer,
+                            ServerConfig)
+
+db = np.random.default_rng(0).standard_normal((20000, 64)).astype(np.float32)
+qdir = tempfile.mkdtemp(prefix="raft-stall-")
+rec = SpanRecorder(2048)
+dumps = []
+srv = SearchServer(db, k=10,
+                   config=ServerConfig(ladder=(8,),
+                                       retry=RetryPolicy(max_retries=2)),
+                   recorder=rec, faults=FaultInjector(),
+                   sleep=lambda s: dumps.append(wd.check(now=srv.clock()
+                                                         + 60.0)))
+wd = srv.attach_watchdog(qdir, stall_timeout_s=30.0, capture_s=0.5)
+srv.warmup()
+d, i = srv.search(db[:4])                      # healthy baseline
+srv.faults.arm("execute", "wedge", times=1)
+d, i = srv.search(db[:4])                      # wedged, retried, answered
+dump = next(d for d in dumps if d)
+cap = json.load(open(os.path.join(dump, "capture.json")))
+assert srv.metrics.stalls == 1, srv.metrics.snapshot()
+assert cap.get("ok"), cap                      # profiler captured for real
+assert glob.glob(os.path.join(dump, "profile", "**", "*.pb"),
+                 recursive=True) or \
+    glob.glob(os.path.join(dump, "profile", "**", "*.json"),
+              recursive=True), "empty profiler capture"
+print(json.dumps({"config": "obs_watchdog_smoke", "dump": dump,
+                  "stalls": srv.metrics.stalls, "capture": cap}))
+PY
+run_step obs_watchdog   900 python "$LOG/obs_watchdog_smoke.py"
+# telemetry overhead on hardware: spans-on vs spans-off serve loop
+# (hardware counterpart of bench/OBS_OVERHEAD_CPU.json)
+run_step obs_overhead  1800 python bench/obs_overhead.py
+# serve bench rides along for the Prometheus surface under real load
+run_step serve_bench   3000 python bench/serve.py
+run_step bench         4500 python bench.py
+echo "$(date) all steps attempted" >> "$LOG/driver.log"

@@ -38,3 +38,7 @@ def test_jax_hygiene_snippets_execute():
 
 def test_mutability_guide_snippets_execute():
     _run_guide("mutability_guide.md", min_blocks=5)
+
+
+def test_observability_guide_snippets_execute():
+    _run_guide("observability_guide.md", min_blocks=4)
