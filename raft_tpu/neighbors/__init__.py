@@ -22,7 +22,7 @@ __all__ = ["brute_force", "knn"]
 
 def __getattr__(name):
     if name in ("ivf_flat", "ivf_pq", "cagra", "refine", "serialize",
-                "mutation", "wal"):
+                "mutation", "wal", "health"):
         import importlib
 
         mod = importlib.import_module(f"raft_tpu.neighbors.{name}")

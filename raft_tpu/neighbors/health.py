@@ -1,0 +1,143 @@
+"""Index-health statistics — the structural quality of a built index.
+
+Search quality degrades for two distinct reasons: the *query path*
+spends less effort (admission ladder, kernel fallbacks — measured
+online by :mod:`raft_tpu.obs.quality`), or the *index itself* got worse
+(a skewed kmeans partition, tombstone bloat, a compaction that mangled
+a graph).  This module measures the second kind, at the only moments it
+can change — build / extend / compact / swap — so a bad generation is
+visible in one scrape instead of a slow recall bleed.
+
+:func:`index_health` extracts per-family structure stats as a flat
+host dict; :func:`export_index_health` lands them in one registry gauge
+family ``raft_index_health{stat,family,generation}`` and prunes retired
+generations so the series set stays bounded.
+
+Per family:
+
+* **ivf_flat / ivf_pq** — list-occupancy balance: coefficient of
+  variation and max-fraction of the per-list counts (imbalance = some
+  lists carry hot spots → probe cost and recall both skew), fullest
+  list / cap (the slab-growth trigger), fraction of empty lists.
+  ``ivf_pq`` adds mean / p95 of the stored residual energy ``‖r̂‖²``
+  (``code_norms``) over live slots — decoded-residual energy is the
+  reconstruction-error proxy available without re-reading raw vectors,
+  and its drift across generations tracks codebook staleness.
+* **cagra** — in-degree distribution of the fixed-out-degree graph
+  (CV, max in-degree fraction, orphan fraction — orphans are
+  unreachable except through seeds), self-loop fraction.
+* **brute_force** — rows only (no structure to degrade).
+* ``mutation.Tombstoned`` — wraps any of the above, adding ``dead`` /
+  ``dead_fraction``.
+
+All transfers are a handful of explicit host scalars at
+build/swap/poll time, never on the search path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+__all__ = ["index_health", "export_index_health"]
+
+
+def _occupancy_stats(counts: np.ndarray, cap: int) -> dict:
+    n_lists = counts.shape[0]
+    total = float(counts.sum())
+    mean = total / n_lists if n_lists else 0.0
+    cv = float(counts.std() / mean) if mean > 0 else 0.0
+    return {
+        "lists": float(n_lists),
+        "list_cap": float(cap),
+        "occupancy_cv": cv,
+        "occupancy_max_fraction":
+            float(counts.max()) / total if total > 0 else 0.0,
+        "occupancy_max": float(counts.max()) / cap if cap else 0.0,
+        "empty_lists_fraction":
+            float((counts == 0).sum()) / n_lists if n_lists else 0.0,
+    }
+
+
+def index_health(index) -> dict:
+    """Structure stats for ``index`` as a flat ``{stat: float}`` dict
+    (plus ``family: str``).  Common keys: ``rows``, ``dead``,
+    ``dead_fraction``; the rest are per-family (see module docstring)."""
+    from .mutation import Tombstoned, deleted_count
+
+    dead = 0.0
+    if isinstance(index, Tombstoned):
+        dead = float(deleted_count(index))
+        index = index.index
+    if getattr(index, "ndim", None) == 2:              # brute database
+        rows = float(index.shape[0])
+        out = {"family": "brute_force", "rows": rows}
+    elif hasattr(index, "graph"):                      # cagra
+        graph = np.asarray(jax.device_get(index.graph))  # jaxlint: disable=JX01 build/swap-time health poll, never on the search path
+        n, deg = graph.shape
+        in_deg = np.bincount(graph.reshape(-1), minlength=n)[:n]
+        mean = float(in_deg.mean()) if n else 0.0
+        out = {
+            "family": "cagra",
+            "rows": float(n),
+            "graph_degree": float(deg),
+            "in_degree_cv": float(in_deg.std() / mean) if mean > 0 else 0.0,
+            "in_degree_max_fraction":
+                float(in_deg.max()) / float(in_deg.sum())
+                if n and in_deg.sum() else 0.0,
+            "orphan_fraction": float((in_deg == 0).sum()) / n if n else 0.0,
+            "self_loop_fraction":
+                float((graph == np.arange(n)[:, None]).sum()) / graph.size
+                if graph.size else 0.0,
+        }
+    elif hasattr(index, "codes"):                      # ivf_pq
+        counts = np.asarray(jax.device_get(index.counts))  # jaxlint: disable=JX01 build/swap-time health poll, never on the search path
+        norms = np.asarray(jax.device_get(index.code_norms))  # jaxlint: disable=JX01 build/swap-time health poll, never on the search path
+        ids = np.asarray(jax.device_get(index.ids))  # jaxlint: disable=JX01 build/swap-time health poll, never on the search path
+        live = norms[ids >= 0]
+        out = {"family": "ivf_pq", "rows": float(counts.sum())}
+        out.update(_occupancy_stats(counts, index.list_cap))
+        out["residual_energy_mean"] = float(live.mean()) if live.size else 0.0
+        out["residual_energy_p95"] = \
+            float(np.percentile(live, 95)) if live.size else 0.0
+    elif hasattr(index, "data"):                       # ivf_flat
+        counts = np.asarray(jax.device_get(index.counts))  # jaxlint: disable=JX01 build/swap-time health poll, never on the search path
+        out = {"family": "ivf_flat", "rows": float(counts.sum())}
+        out.update(_occupancy_stats(counts, index.list_cap))
+    else:
+        raise TypeError(f"no health stats for {type(index).__name__}")
+    out["dead"] = dead
+    out["dead_fraction"] = dead / out["rows"] if out["rows"] else 0.0
+    return out
+
+
+def export_index_health(registry, index, *, generation: Optional[int] = None,
+                        keep_generations: int = 4) -> dict:
+    """Compute :func:`index_health` and land every stat in the registry
+    gauge family ``raft_index_health{stat,family,generation}``.
+
+    One gauge family (not one per stat) keeps the exposition's shape
+    fixed as families come and go across swaps.  Generations older than
+    the newest ``keep_generations`` are pruned from the family — the
+    point of per-generation labels is comparing a swap against its
+    predecessor, not unbounded history.  Returns the stats dict."""
+    stats = index_health(index)
+    gen = str(0 if generation is None else int(generation))
+    family = stats["family"]
+    g = registry.gauge(
+        "raft_index_health",
+        "per-generation index structure stats (see neighbors.health)")
+    for stat, value in stats.items():
+        if stat == "family":
+            continue
+        g.set(value, stat=stat, family=family, generation=gen)
+    gens = sorted({int(labels["generation"])
+                   for labels, _ in g.samples()
+                   if labels.get("generation", "").lstrip("-").isdigit()})
+    for old in gens[:-keep_generations] if keep_generations > 0 else gens:
+        for labels, _ in g.samples():
+            if labels.get("generation") == str(old):
+                g.remove(**labels)
+    return stats

@@ -23,8 +23,18 @@ substrate they all report through:
   unchanged and now derivable from the same registry.
 * **watchdog** (:mod:`.watchdog`) — :class:`StallWatchdog` detects a
   wedged device dispatch, dumps flight recorder + ``jax.profiler``
-  capture to a quarantine directory, and counts ``stalls`` instead of
-  hanging silently.
+  capture to a quarantine directory (retained newest-K), and counts
+  ``stalls`` instead of hanging silently.
+* **quality** (:mod:`.quality`) — :class:`RecallEstimator`
+  shadow-samples live requests and re-scores them against an exact
+  blocked-scan oracle off the hot path: online recall@k with Wilson
+  CIs, labeled by degradation level / scan kernel / generation.
+* **drift** (:mod:`.drift`) — :class:`DriftDetector`, a streaming PSI
+  sketch of the query-to-centroid distance distribution vs the
+  build-time baseline.
+* **slo** (:mod:`.slo`) — :class:`SloEvaluator`, multi-window burn
+  rates over latency / availability / recall, and the ``quality_guard``
+  the server's degradation ladder consults before entering a level.
 
 Everything except the profiler capture is pure stdlib: importable
 without jax, zero device interaction, safe on any host.  See
@@ -42,10 +52,14 @@ the stall runbook.
 True
 """
 
+from .drift import DriftDetector
 from .metrics import (DEFAULT_LATENCY_BOUNDARIES_MS, Counter, Gauge,
                       Histogram, MetricRegistry, registry, set_registry)
 from .perfetto import chrome_trace, export_chrome_trace
 from .prometheus import parse_text, render
+from .quality import (QualityConfig, RecallEstimate, RecallEstimator,
+                      wilson_interval)
+from .slo import SloEvaluator, SloPolicy
 from .spans import Span, SpanRecorder, recorder, set_recorder
 from .watchdog import StallWatchdog
 
@@ -66,4 +80,11 @@ __all__ = [
     "chrome_trace",
     "export_chrome_trace",
     "StallWatchdog",
+    "QualityConfig",
+    "RecallEstimate",
+    "RecallEstimator",
+    "wilson_interval",
+    "DriftDetector",
+    "SloEvaluator",
+    "SloPolicy",
 ]

@@ -60,6 +60,13 @@ class _Family:
         with self._lock:
             return self._vals.get(_label_key(labels), 0.0)
 
+    def remove(self, **labels) -> bool:
+        """Drop one label-set (e.g. a retired index generation) so
+        bounded-cardinality exporters don't accumulate dead series.
+        Returns whether the label-set existed."""
+        with self._lock:
+            return self._vals.pop(_label_key(labels), None) is not None
+
 
 class Counter(_Family):
     """Monotonic count, optionally labelled:
@@ -135,12 +142,18 @@ class Histogram:
         with self._lock:
             return sum(self._counts.get(_label_key(labels), ()))
 
-    def quantile(self, q: float, **labels) -> float:
-        """Conservative quantile: the upper boundary of the bucket where
-        the cumulative count reaches ``q`` (0 < q <= 1).  Differs from an
-        exact percentile over the same observations by at most one bucket
-        width; returns the top finite boundary for overflow quantiles and
-        0.0 when empty."""
+    def quantile(self, q: float, *, interpolate: bool = False,
+                 **labels) -> float:
+        """Bucketed quantile (0 < q <= 1).  The default is the
+        *conservative* estimate — the upper boundary of the bucket where
+        the cumulative count reaches ``q`` — which never understates and
+        differs from an exact percentile over the same observations by
+        at most one bucket width.  ``interpolate=True`` instead places
+        the quantile linearly *within* that bucket (the Prometheus
+        ``histogram_quantile`` convention): usually closer to the exact
+        value, but it can land on either side of it.  Both estimates lie
+        in the same bucket.  Returns the top finite boundary for
+        overflow quantiles and 0.0 when empty."""
         from ..core.errors import expects
 
         expects(0.0 < q <= 1.0, "quantile q must lie in (0, 1]")
@@ -152,10 +165,22 @@ class Histogram:
         need = q * total
         cum = 0
         for i, c in enumerate(counts[:-1]):
+            if cum + c >= need:
+                hi = self.boundaries[i]
+                if not interpolate:
+                    return hi
+                lo = self.boundaries[i - 1] if i > 0 else 0.0
+                return lo + (need - cum) / c * (hi - lo)
             cum += c
-            if cum >= need:
-                return self.boundaries[i]
         return self.boundaries[-1]
+
+    def remove(self, **labels) -> bool:
+        """Drop one label-set's buckets (see :meth:`_Family.remove`)."""
+        key = _label_key(labels)
+        with self._lock:
+            existed = self._counts.pop(key, None) is not None
+            self._sums.pop(key, None)
+        return existed
 
     def bucket_width(self, v: float) -> float:
         """Width of the bucket containing ``v`` — the exporter-vs-exact

@@ -1,0 +1,260 @@
+"""SLO evaluation — multi-window burn rates and the recall quality guard.
+
+Raw metrics answer "what is happening"; an SLO answers "is it bad
+enough to act".  This module implements the standard SRE error-budget
+machinery over three serving SLOs and wires the recall one back into
+the control loop that can violate it:
+
+* **latency** — fraction of requests answering within
+  ``SloPolicy.latency_ms``, read from the mergeable latency histogram
+  (bucketed: requests in the bucket straddling the target count as bad,
+  the conservative side);
+* **availability** — answered vs rejected/faulted, from the serving
+  counters;
+* **recall** — shadow-sampled requests at or above
+  ``SloPolicy.recall_floor``, from :class:`raft_tpu.obs.quality.
+  RecallEstimator`'s cumulative feed.
+
+Each SLO is tracked with **multi-window burn rates**: the error budget
+(``1 − target``) spent per unit, measured over a short and a long
+window simultaneously — the long window filters blips, the short window
+makes alerts reset promptly once the problem stops.  Both must exceed
+the threshold to alert (page at ``burn_page``×, warn at
+``burn_warn``×).  Windows are *event-counted*, not wall-clock, so a
+fake-clock test drives the exact same math as production.
+
+The **quality guard** closes the loop: ``quality_guard(level)`` returns
+the deepest degradation level at or below the requested one whose
+measured recall CI does not sit below the floor — the server asks it
+before entering a ladder level, so a level that demonstrably breaks the
+recall SLO is refused (counted, as ``quality_guard_overrides``) while
+levels with no evidence yet stay allowed (the ladder must still work
+cold).  Level 0 is always allowed: full effort is the best the index
+can do, and the load ladder must have a floor.
+
+Pure stdlib, like the rest of :mod:`raft_tpu.obs`.
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+from collections import deque
+from typing import Dict, Optional, Tuple
+
+__all__ = ["SloPolicy", "SloEvaluator"]
+
+_STATES = {"ok": 0, "warn": 1, "page": 2}
+
+
+@dataclasses.dataclass(frozen=True)
+class SloPolicy:
+    """Targets + burn thresholds for :class:`SloEvaluator`.
+
+    ``latency_ms`` / ``latency_budget``: requests slower than the target
+    may consume at most ``latency_budget`` of traffic; ``availability``:
+    answered fraction target; ``recall_floor`` / ``recall_bad_budget``:
+    sampled requests below the floor may consume at most the budget.
+    ``short_window`` / ``long_window`` are event counts (see module
+    docstring); ``min_samples`` gates both alerting and the guard — an
+    estimate with fewer sampled requests is *unknown*, not bad."""
+
+    latency_ms: float = 64.0
+    latency_budget: float = 0.05
+    availability: float = 0.999
+    recall_floor: float = 0.9
+    recall_bad_budget: float = 0.10
+    short_window: int = 32
+    long_window: int = 256
+    burn_warn: float = 2.0
+    burn_page: float = 8.0
+    min_samples: int = 8
+
+    def __post_init__(self):
+        from ..core.errors import expects
+
+        expects(self.latency_ms > 0, "latency_ms must be > 0")
+        expects(0.0 < self.latency_budget < 1.0,
+                "latency_budget must lie in (0, 1)")
+        expects(0.0 < self.availability < 1.0,
+                "availability must lie in (0, 1)")
+        expects(0.0 < self.recall_floor <= 1.0,
+                "recall_floor must lie in (0, 1]")
+        expects(0.0 < self.recall_bad_budget < 1.0,
+                "recall_bad_budget must lie in (0, 1)")
+        expects(1 <= self.short_window <= self.long_window,
+                "need 1 <= short_window <= long_window")
+        expects(0.0 < self.burn_warn <= self.burn_page,
+                "need 0 < burn_warn <= burn_page")
+        expects(self.min_samples >= 1, "min_samples must be >= 1")
+
+
+class _BudgetTrack:
+    """One SLO's event history: cumulative (total, bad) deltas per
+    ``evaluate()`` call, walked backwards to form event-counted
+    windows."""
+
+    def __init__(self, budget: float, maxlen: int = 4096) -> None:
+        self.budget = float(budget)
+        self._last: Tuple[float, float] = (0.0, 0.0)
+        self._hist: deque = deque(maxlen=maxlen)
+
+    def push(self, total: float, bad: float) -> None:
+        lt, lb = self._last
+        if total < lt or bad < lb:        # counter reset (fresh metrics)
+            lt, lb = 0.0, 0.0
+        self._hist.append((total - lt, bad - lb))
+        self._last = (total, bad)
+
+    def burn(self, window_events: int) -> float:
+        """Budget-normalized bad fraction over the newest ``window_events``
+        events (0.0 while no events): 1.0 = burning exactly the budget."""
+        total = bad = 0.0
+        for dt, db in reversed(self._hist):
+            total += dt
+            bad += db
+            if total >= window_events:
+                break
+        if total <= 0:
+            return 0.0
+        return (bad / total) / self.budget
+
+
+class SloEvaluator:
+    """Periodically fold serving + quality metrics into burn rates,
+    alert states, and the degradation quality guard.
+
+    ``metrics`` is the server's :class:`raft_tpu.serve.ServingMetrics`;
+    ``estimator`` the optional :class:`~raft_tpu.obs.quality.
+    RecallEstimator` (without one the recall SLO reads as empty).
+    Gauges/counters land in ``registry`` (default: the metrics' own, so
+    one scrape carries everything): ``raft_slo_burn_rate{slo,window}``,
+    ``raft_slo_state{slo}`` (0 ok / 1 warn / 2 page), and
+    ``raft_slo_alerts_total{slo,severity}`` counted on each transition
+    into warn/page.  Drive :meth:`evaluate` on whatever cadence suits —
+    per scrape, per N requests, or inline in deterministic tests."""
+
+    def __init__(self, metrics, estimator=None,
+                 policy: Optional[SloPolicy] = None, *,
+                 registry=None, recorder=None) -> None:
+        from .spans import recorder as default_recorder
+
+        self.metrics = metrics
+        self.estimator = estimator
+        self.policy = policy or SloPolicy()
+        self.registry = registry if registry is not None \
+            else metrics.registry
+        self.recorder = recorder if recorder is not None \
+            else default_recorder()
+        p = self.policy
+        self._tracks: Dict[str, _BudgetTrack] = {
+            "latency": _BudgetTrack(p.latency_budget),
+            "availability": _BudgetTrack(1.0 - p.availability),
+            "recall": _BudgetTrack(p.recall_bad_budget),
+        }
+        self.states: Dict[str, str] = {s: "ok" for s in self._tracks}
+        self.overrides = 0          # guard refusals (cumulative)
+        self._g_burn = self.registry.gauge(
+            "raft_slo_burn_rate",
+            "error-budget burn rate per SLO and window (1.0 = on budget)")
+        self._g_state = self.registry.gauge(
+            "raft_slo_state", "per-SLO alert state (0 ok, 1 warn, 2 page)")
+        self._c_alerts = self.registry.counter(
+            "raft_slo_alerts_total", "transitions into warn/page per SLO")
+        if estimator is not None:
+            estimator.track_floor(p.recall_floor)
+        for slo in self._tracks:
+            self._g_state.set(0, slo=slo)
+
+    # -- cumulative feeds ---------------------------------------------------
+
+    def _latency_events(self) -> Tuple[float, float]:
+        hist = self.metrics.latency_hist
+        samples = hist.samples()
+        if not samples:
+            return 0.0, 0.0
+        counts = samples[0][1]
+        idx = bisect.bisect_right(hist.boundaries, self.policy.latency_ms)
+        total = float(sum(counts))
+        return total, total - float(sum(counts[:idx]))
+
+    def _availability_events(self) -> Tuple[float, float]:
+        m = self.metrics
+        bad = float(m.counter_value("rejected_queue_full")
+                    + m.counter_value("rejected_deadline")
+                    + m.counter_value("faulted_batches"))
+        return float(m.counter_value("completed")) + bad, bad
+
+    def _recall_events(self) -> Tuple[float, float]:
+        if self.estimator is None:
+            return 0.0, 0.0
+        return (float(self.estimator.samples_total),
+                float(self.estimator.samples_below_floor))
+
+    # -- evaluation ---------------------------------------------------------
+
+    def evaluate(self) -> Dict[str, dict]:
+        """One evaluation pass: pull cumulative events, refresh burn
+        windows, update states/gauges, count transitions.  Returns
+        ``{slo: {burn_short, burn_long, state}}``."""
+        p = self.policy
+        feeds = {"latency": self._latency_events(),
+                 "availability": self._availability_events(),
+                 "recall": self._recall_events()}
+        out: Dict[str, dict] = {}
+        for slo, (total, bad) in feeds.items():
+            track = self._tracks[slo]
+            track.push(total, bad)
+            short = track.burn(p.short_window)
+            long_ = track.burn(p.long_window)
+            # both windows must agree: the long window proves it is
+            # sustained, the short window proves it is still happening
+            floor = min(short, long_)
+            state = "page" if floor >= p.burn_page else \
+                "warn" if floor >= p.burn_warn else "ok"
+            prev = self.states[slo]
+            if state != prev:
+                self.states[slo] = state
+                if _STATES[state] > _STATES[prev]:
+                    self._c_alerts.inc(slo=slo, severity=state)
+                    self.recorder.event("obs.slo_alert", slo=slo,
+                                        severity=state,
+                                        burn_short=round(short, 3),
+                                        burn_long=round(long_, 3))
+            self._g_burn.set(short, slo=slo, window="short")
+            self._g_burn.set(long_, slo=slo, window="long")
+            self._g_state.set(_STATES[state], slo=slo)
+            out[slo] = {"burn_short": short, "burn_long": long_,
+                        "state": state}
+        return out
+
+    # -- the guard ----------------------------------------------------------
+
+    def quality_guard(self, level: int) -> int:
+        """The deepest allowed degradation level <= ``level``: a level is
+        refused when its windowed recall estimate has at least
+        ``min_samples`` sampled requests AND its Wilson CI lies entirely
+        below ``recall_floor`` (``ci_high < floor`` — the measured upper
+        bound cannot reach the SLO).  Unknown levels pass: refusing
+        unmeasured levels would deadlock a cold ladder."""
+        lvl = int(level)
+        if self.estimator is None:
+            return lvl
+        p = self.policy
+        while lvl > 0:
+            est = self.estimator.estimate(lvl)
+            if est.samples < p.min_samples or est.ci_high >= p.recall_floor:
+                return lvl
+            lvl -= 1
+        return lvl
+
+    def stats(self) -> dict:
+        """JSON-ready snapshot for ``metrics_snapshot()['slo']``."""
+        p = self.policy
+        return {
+            "states": dict(self.states),
+            "overrides": self.overrides,
+            "burn": {slo: {"short": t.burn(p.short_window),
+                           "long": t.burn(p.long_window)}
+                     for slo, t in self._tracks.items()},
+        }

@@ -43,23 +43,32 @@ class StallWatchdog:
     ``metrics_snapshot()`` (duck-typed —
     :class:`raft_tpu.serve.SearchServer` and the tests' fakes both
     qualify).  ``capture_s`` bounds the profiler capture; 0 disables it
-    (flight recorder + metrics still dump)."""
+    (flight recorder + metrics still dump).
+
+    ``max_dumps`` is the quarantine retention policy: after each dump,
+    only the newest ``max_dumps`` ``stall-*`` directories are kept and
+    the rest are pruned (counted — ``stall_dumps_pruned``).  A flapping
+    wedge used to fill the disk with one directory per episode; the
+    newest dumps are the ones being debugged.  0 disables pruning."""
 
     def __init__(self, server, quarantine_dir, *,
                  stall_timeout_s: float = 30.0,
                  poll_interval_s: float = 1.0,
                  capture_s: float = 0.25,
+                 max_dumps: int = 16,
                  recorder=None, clock=None, sleep=time.sleep) -> None:
         from ..core.errors import expects
 
         expects(stall_timeout_s > 0, "stall_timeout_s must be > 0")
         expects(poll_interval_s > 0, "poll_interval_s must be > 0")
         expects(capture_s >= 0, "capture_s must be >= 0")
+        expects(max_dumps >= 0, "max_dumps must be >= 0")
         self.server = server
         self.quarantine_dir = os.fspath(quarantine_dir)
         self.stall_timeout_s = float(stall_timeout_s)
         self.poll_interval_s = float(poll_interval_s)
         self.capture_s = float(capture_s)
+        self.max_dumps = int(max_dumps)
         self.clock = clock if clock is not None else server.clock
         self._sleep = sleep
         if recorder is None:
@@ -68,6 +77,7 @@ class StallWatchdog:
             recorder = default_recorder()
         self.recorder = recorder
         self.stalls_detected = 0
+        self.pruned_total = 0
         self.dumps: list = []          # dump dir paths, oldest first
         self._latched_t0: Optional[float] = None
         self._thread: Optional[threading.Thread] = None
@@ -96,6 +106,7 @@ class StallWatchdog:
                             stalled_s=round(now - t0, 3))
         path = self._dump(site, now - t0)
         self.dumps.append(path)
+        self._prune()
         return path
 
     # -- evidence -----------------------------------------------------------
@@ -131,6 +142,44 @@ class StallWatchdog:
             "(timeout %.1fs) — flight recorder + profiler capture dumped "
             "to %s", site, stalled_s, self.stall_timeout_s, out)
         return out
+
+    def _prune(self) -> int:
+        """Apply the retention policy: drop the oldest ``stall-*``
+        directories beyond ``max_dumps``.  Ordered by the zero-padded
+        episode number in the name (stall-001 < stall-002 < ...), so
+        retention is deterministic and independent of filesystem
+        timestamps; directories from a previous process count too —
+        retention is a property of the quarantine dir, not this run."""
+        if self.max_dumps <= 0:
+            return 0
+        import shutil
+
+        try:
+            entries = sorted(
+                e for e in os.listdir(self.quarantine_dir)
+                if e.startswith("stall-")
+                and os.path.isdir(os.path.join(self.quarantine_dir, e)))
+        except OSError:
+            return 0
+        pruned = 0
+        for name in entries[:-self.max_dumps]:
+            path = os.path.join(self.quarantine_dir, name)
+            try:
+                shutil.rmtree(path)
+            except OSError:
+                continue                  # busy/foreign dir: keep it
+            pruned += 1
+            if path in self.dumps:
+                self.dumps.remove(path)
+        if pruned:
+            self.pruned_total += pruned
+            try:
+                self.server.metrics.count("stall_dumps_pruned", pruned)
+            except Exception:  # noqa: BLE001 — fakes without the counter
+                pass
+            self.recorder.event("obs.stall_dumps_pruned", n=pruned,
+                                kept=self.max_dumps)
+        return pruned
 
     def _profiler_capture(self, logdir: str) -> dict:
         """Best-effort ``jax.profiler`` capture.  The profiler runs on

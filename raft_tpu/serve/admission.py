@@ -15,7 +15,7 @@ overload costs recall instead of latency.
 from __future__ import annotations
 
 import dataclasses
-from typing import Tuple
+from typing import Optional, Tuple
 
 from ..core.errors import RaftError, expects
 
@@ -153,6 +153,24 @@ class AdmissionController:
         for frac in self.policy.degrade_queue_fractions:
             if depth >= frac * self.policy.max_queue:
                 lvl += 1
+        return lvl
+
+    def guarded_level(self, depth: int, guard=None,
+                      max_level: Optional[int] = None) -> int:
+        """:meth:`level`, clamped to ``max_level`` and then passed
+        through ``guard`` (an int -> int callable — e.g.
+        :meth:`raft_tpu.obs.slo.SloEvaluator.quality_guard` via the
+        server — that may only *lower* the level; a guard asking for a
+        deeper level than the ladder requested is a bug)."""
+        lvl = self.level(depth)
+        if max_level is not None:
+            lvl = min(lvl, int(max_level))
+        if guard is not None:
+            guarded = int(guard(lvl))
+            expects(0 <= guarded <= lvl,
+                    f"quality guard returned level {guarded}, outside "
+                    f"[0, {lvl}] — guards may only lower the level")
+            lvl = guarded
         return lvl
 
     def deadline(self, now: float, deadline_ms=None) -> float:

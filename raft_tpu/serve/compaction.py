@@ -34,9 +34,6 @@ import threading
 import time
 from typing import Optional
 
-import jax
-import jax.numpy as jnp
-
 from ..core.errors import expects
 
 __all__ = ["CompactionPolicy", "CompactionScheduler"]
@@ -91,29 +88,15 @@ class CompactionScheduler:
         """Registry-sampled trigger inputs for the CURRENT generation:
         ``rows``, ``dead`` (tombstoned ids), ``dead_fraction``, and
         ``occupancy`` (fullest IVF list / cap; 0 for list-less
-        families).  Two explicit host scalars per poll, never on the
-        dispatch path."""
-        from ..neighbors import mutation
+        families).  Shares :func:`raft_tpu.neighbors.health.index_health`
+        with the per-generation health gauges — a handful of explicit
+        host scalars per poll, never on the dispatch path."""
+        from ..neighbors.health import index_health
 
-        index = self.server.index
-        rows = float(index.shape[0]
-                     if getattr(index, "ndim", None) == 2
-                     else index.size)
-        dead = 0
-        if isinstance(index, mutation.Tombstoned):
-            dead = mutation.deleted_count(index)
-        base = index.index if isinstance(index, mutation.Tombstoned) \
-            else index
-        occupancy = 0.0
-        counts = getattr(base, "counts", None)
-        cap = getattr(base, "list_cap", 0)
-        if counts is not None and cap:
-            # scheduler poll scalar, off the search path
-            fullest = int(jax.device_get(jnp.max(counts)))  # jaxlint: disable=JX01 scheduler poll scalar, never on the dispatch path
-            occupancy = fullest / float(cap)
-        return {"rows": rows, "dead": dead,
-                "dead_fraction": dead / rows if rows else 0.0,
-                "occupancy": occupancy}
+        h = index_health(self.server.index)
+        return {"rows": h["rows"], "dead": int(h["dead"]),
+                "dead_fraction": h["dead_fraction"],
+                "occupancy": h.get("occupancy_max", 0.0)}
 
     def due(self, now: Optional[float] = None) -> Optional[str]:
         """The trigger that fires now ("dead_fraction" / "overfull"), or

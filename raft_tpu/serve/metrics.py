@@ -74,6 +74,11 @@ COUNTER_SPECS = (
     ("compactions_scheduled", "scheduler trigger firings"),
     ("compactions_completed", "compaction + swap succeeded"),
     ("compactions_failed", "compaction attempts rolled back"),
+    ("quality_samples", "requests shadow-sampled for the recall oracle"),
+    ("quality_sample_drops", "shadow samples dropped at the bounded queue"),
+    ("quality_guard_overrides",
+     "degradation levels refused by the recall guard"),
+    ("stall_dumps_pruned", "quarantined stall dumps removed by retention"),
 )
 
 

@@ -45,10 +45,14 @@ class IndexRegistry:
     serializes writers, keeping ``gen_id`` strictly increasing when
     several background builders race."""
 
-    def __init__(self, index) -> None:
+    def __init__(self, index, *, on_swap=None) -> None:
         self._lock = threading.Lock()
         self._current = Generation(index, 0)
         self.swaps = 0
+        #: optional callable invoked with each newly installed
+        #: :class:`Generation`, outside the lock (the server hangs its
+        #: index-health export here — see ``neighbors.health``)
+        self.on_swap = on_swap
 
     @property
     def current(self) -> Generation:
@@ -67,4 +71,7 @@ class IndexRegistry:
             gen = Generation(new_index, self._current.gen_id + 1)
             self._current = gen
             self.swaps += 1
-            return gen
+        cb = self.on_swap
+        if cb is not None:       # outside the lock: the hook may be slow
+            cb(gen)
+        return gen

@@ -142,6 +142,15 @@ class SearchServer:
         self._pending: list = []
         self._thread: Optional[threading.Thread] = None
         self._running = False
+        # quality telemetry (opt-in via attach_quality); index-health
+        # gauges are always on — recomputed for every swapped-in
+        # generation so a bad compaction is visible in one scrape
+        self.quality = None        # obs.quality.RecallEstimator
+        self.slo = None            # obs.slo.SloEvaluator
+        self._scan_kernel = str(
+            getattr(self.params, "scan_kernel", None) or "xla")
+        self._registry.on_swap = self._export_health
+        self._export_health()
 
     @property
     def index(self):
@@ -347,10 +356,25 @@ class SearchServer:
                                  self.recorder.clock_ns(),
                                  parent=batch[0].span,
                                  n_requests=len(batch), queue_depth=depth)
-        level = min(self.admission.level(depth),
-                    len(self.config.degrade_effort_scales) - 1)
+        level = self.admission.guarded_level(
+            depth, self._apply_quality_guard,
+            max_level=len(self.config.degrade_effort_scales) - 1)
         self._execute(batch, bucket, level)
         return len(expired) + len(batch)
+
+    def _apply_quality_guard(self, level: int) -> int:
+        """Ask the SLO evaluator's recall guard before entering a ladder
+        level; a refusal (guard picks a shallower level) is counted and
+        recorded — the scrapeable trace of quality overriding load."""
+        if self.slo is None:
+            return level
+        allowed = self.slo.quality_guard(level)
+        if allowed != level:
+            self.slo.overrides += 1
+            self.metrics.count("quality_guard_overrides")
+            self.recorder.event("serve.quality_guard",
+                                requested=int(level), allowed=int(allowed))
+        return allowed
 
     def _parts(self, k: int, level: int, gen=None):
         """(fn, operands) for one (generation, k, level) — memoized so the
@@ -482,6 +506,14 @@ class SearchServer:
             hi = lo + req.rows
             reply_ns = self.recorder.clock_ns() if self.recorder.enabled else 0
             req.resolve(d[lo:hi], i[lo:hi])
+            if self.quality is not None:
+                # shadow-sampling hook: one hash per request; selected
+                # requests copy onto the bounded oracle queue (overflow
+                # drops) — the reply above is already on its way
+                self.quality.maybe_sample(
+                    req.queries, i[lo:hi], level=level,
+                    generation=self._registry.gen_id,
+                    scan_kernel=self._scan_kernel)
             if req.span is not None:
                 self.recorder.record("serve.reply", reply_ns,
                                      self.recorder.clock_ns(),
@@ -597,6 +629,57 @@ class SearchServer:
         mode.  Reads are lock-free: a Python tuple swap is atomic."""
         return self._inflight
 
+    def _export_health(self, gen=None) -> dict:
+        """Compute + export :func:`raft_tpu.neighbors.health.index_health`
+        gauges for one generation (the ``IndexRegistry.on_swap`` hook;
+        also runs at construction for generation 0).  Health telemetry
+        must never take down serving, so failures degrade to an empty
+        dict instead of raising out of a swap."""
+        from ..neighbors.health import export_index_health
+
+        gen = self._registry.current if gen is None else gen
+        try:
+            return export_index_health(self.metrics.registry, gen.index,
+                                       generation=gen.gen_id)
+        except Exception as exc:  # noqa: BLE001 — telemetry, not control
+            self.recorder.event("serve.health_export_error",
+                                generation=gen.gen_id,
+                                error=type(exc).__name__)
+            return {}
+
+    def attach_quality(self, config=None, *, policy=None,
+                       baseline_queries=None):
+        """Wire the search-quality telemetry loop onto this server:
+        a :class:`raft_tpu.obs.quality.RecallEstimator` shadow-sampling
+        live requests (``config``: its ``QualityConfig``), an
+        :class:`raft_tpu.obs.slo.SloEvaluator` over latency /
+        availability / recall (``policy``: its ``SloPolicy``) whose
+        recall guard the degradation ladder now consults, and — when
+        ``baseline_queries`` is given — a
+        :class:`raft_tpu.obs.drift.DriftDetector` fed from the sampled
+        queries.  All metrics land in this server's registry, so
+        :meth:`prometheus_text` carries them.
+
+        Returns the estimator.  Call ``.start()`` on it for a background
+        oracle worker, or drive ``.drain()`` inline in deterministic
+        tests.  Attach before ``start()``; re-attaching replaces the
+        previous wiring."""
+        from ..obs.quality import RecallEstimator
+        from ..obs.slo import SloEvaluator
+
+        self.quality = RecallEstimator(
+            self.index, self.k, config, registry=self.metrics.registry,
+            metrics=self.metrics, recorder=self.recorder)
+        if baseline_queries is not None:
+            from ..obs.drift import DriftDetector
+
+            self.quality.drift = DriftDetector.from_index(
+                self.index, baseline_queries,
+                registry=self.metrics.registry)
+        self.slo = SloEvaluator(self.metrics, self.quality, policy,
+                                recorder=self.recorder)
+        return self.quality
+
     def attach_watchdog(self, quarantine_dir, **kw):
         """Construct (NOT start) a :class:`raft_tpu.obs.StallWatchdog`
         over this server's dispatch marker, flight recorder and metrics;
@@ -653,6 +736,9 @@ class SearchServer:
             "degrade_level": self.admission.level(depth),
             "cache": self.cache.snapshot(),
             "obs": self.recorder.stats(),
+            "quality": (self.quality.stats()
+                        if self.quality is not None else None),
+            "slo": self.slo.stats() if self.slo is not None else None,
             "server": {"family": self.family, "k": self.k,
                        "ladder": list(self.ladder),
                        "index_rows": index_size(self.index),

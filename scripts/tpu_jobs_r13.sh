@@ -1,0 +1,142 @@
+#!/bin/bash
+# Round-13 TPU job queue: first hardware round for search-quality
+# telemetry (raft_tpu.obs quality/drift/slo + neighbors.health —
+# ISSUE 11).
+#   * mosaic re-stamps bench/MOSAIC_CHECK.json first, as always — the
+#     dispatch gate rejects stale kernel_sha stamps.
+#   * quality_drill — the injected-regression drill from
+#     tests/test_quality.py staged on real hardware: saturate the queue
+#     so the ladder degrades, the shadow-sampled estimator catches the
+#     recall drop, the recall SLO burns, and the guard pins dispatch
+#     back to level 0.  The CPU tier proves the control loop; this step
+#     proves the oracle (blocked_scan off the hot path) and the sampler
+#     behave on the device that serves.
+#   * obs_overhead_r13 — bench/obs_overhead.py re-run under a NEW
+#     marker: the bench gained the quality-sampler arm this round, so
+#     r12's obs_overhead.done must not short-circuit it.  Hardware
+#     counterpart of the committed bench/QUALITY_OVERHEAD_CPU.json.
+# Stage order: jaxlint -> mosaic -> quality drill -> obs overhead ->
+# serve bench -> bench.py.
+# Markers stay in /tmp/tpu_jobs_r3 so steps completed by earlier rounds'
+# queues are not repeated.
+set -u
+cd /root/repo || exit 1
+LOG=/tmp/tpu_jobs_r3
+mkdir -p "$LOG"
+. "$(dirname "$0")/tpu_queue_lib.sh"
+acquire_queue_lock tpu_jobs_r13
+export RAFT_BENCH_CKPT_DIR="$LOG/bench_ckpt"
+
+echo "$(date) [r13 queue] waiting for TPU..." >> "$LOG/driver.log"
+wait_probe
+echo "$(date) TPU is back" >> "$LOG/driver.log"
+
+run_step() {  # name, timeout_s, command...   (two attempts, gated .done)
+  local name=$1 tmo=$2; shift 2
+  [ -f "$LOG/$name.done" ] && return 0
+  local attempt
+  for attempt in 1 2; do
+    echo "$(date) start $name (attempt $attempt)" >> "$LOG/driver.log"
+    timeout "$tmo" "$@" > "$LOG/$name.$attempt.log" 2>&1 9<&-
+    rc=$?
+    cp -f "$LOG/$name.$attempt.log" "$LOG/$name.log"  # latest = canonical
+    if [ "$rc" -eq 0 ]; then
+      if [ "$name" != bench ] || bench_measured "$LOG/$name.log" brute_force; then
+        touch "$LOG/$name.done"
+        echo "$(date) done $name" >> "$LOG/driver.log"
+        return 0
+      fi
+      echo "$(date) $name exited 0 with no headline measurement (wedged backend)" \
+        >> "$LOG/driver.log"
+    else
+      echo "$(date) FAILED $name (rc=$rc)" >> "$LOG/driver.log"
+    fi
+    # a killed/wedged client can poison the tunnel for the next step too:
+    # re-probe before the retry (or before handing on to the next step)
+    wait_probe
+  done
+}
+
+# jaxlint first: pure-host AST pass (quality/drift/slo/health carry
+# explicit JX01 waivers on their oracle-side device_gets), zero chip time
+run_step jaxlint_r13    300 python scripts/mini_lint.py --jax raft_tpu --stats-json bench/JAXLINT.json
+# mosaic BEFORE anything that dispatches Pallas: re-validates the kernels
+# on hardware and stamps the sha-scoped artifact the dispatch gate needs
+run_step mosaic         900 env RAFT_MOSAIC_REQUIRE_TPU=1 python scripts/mosaic_check.py
+# the quality-regression drill on hardware: recall drop at the degraded
+# level -> estimator CI below floor -> recall SLO burn -> guard refuses
+# the level (written to a file first: run_step retries must not re-read
+# stdin)
+cat > "$LOG/quality_drill_smoke.py" <<'PY'
+import json, os, sys
+
+sys.path.insert(0, os.getcwd())        # the queue runs this from /root/repo
+
+import numpy as np
+from raft_tpu.neighbors import ivf_flat
+from raft_tpu.obs import QualityConfig, SloPolicy, SpanRecorder, parse_text
+from raft_tpu.serve import SearchServer, ServerConfig
+
+db = np.random.default_rng(7).standard_normal((4000, 32)).astype(np.float32)
+index = ivf_flat.build(db, ivf_flat.IvfFlatIndexParams(
+    n_lists=64, kmeans_n_iters=4))
+# level 0 probes every list (exact); level 1's effort scale floors
+# n_probes to 1 — a gross recall regression only queue pressure triggers
+srv = SearchServer(index, k=8,
+                   params=ivf_flat.IvfFlatSearchParams(n_probes=64),
+                   config=ServerConfig(ladder=(8,), max_queue=16,
+                                       max_wait_ms=0.0,
+                                       degrade_queue_fractions=(0.25,),
+                                       degrade_effort_scales=(1.0, 0.02)),
+                   recorder=SpanRecorder(512))
+est = srv.attach_quality(
+    QualityConfig(sample_fraction=1.0, rows_cap=8),
+    policy=SloPolicy(recall_floor=0.9, min_samples=4,
+                     short_window=4, long_window=8),
+    baseline_queries=db[:256])
+srv.warmup()
+
+
+def drive(n_parallel):
+    futs = [srv.submit(db[(j * 8) % 256:(j * 8) % 256 + 8])
+            for j in range(n_parallel)]
+    while srv.step():
+        pass
+    for f in futs:
+        f.result(timeout=60)
+    est.drain()
+    srv.slo.evaluate()
+
+
+for _ in range(6):                       # healthy: level 0, recall ~1
+    drive(1)
+healthy = est.estimate(0)
+assert healthy.samples >= 6 and healthy.ci_low > 0.9, est.stats()
+drive(8)                                 # saturate -> level 1 regression
+bad = est.estimate(1)
+assert bad.samples >= 4 and bad.ci_high < 0.9, est.stats()
+assert srv.slo.states["recall"] in ("warn", "page"), srv.slo.states
+before = dict(srv.metrics.degrade_dispatches)
+drive(8)                                 # guard pins dispatch to level 0
+after = srv.metrics.degrade_dispatches
+assert after.get(1, 0) == before.get(1, 0), (before, after)
+assert srv.metrics.quality_guard_overrides > 0
+assert srv.slo.states["recall"] == "ok", srv.slo.states
+parsed = parse_text(srv.prometheus_text())
+assert any(labels["slo"] == "recall" and v >= 1.0
+           for labels, v in parsed["raft_slo_alerts_total"])
+assert any(labels.get("stat") == "occupancy_cv"
+           for labels, _ in parsed["raft_index_health"])
+print(json.dumps({"config": "quality_drill_smoke",
+                  "healthy_ci_low": round(healthy.ci_low, 4),
+                  "degraded_ci_high": round(bad.ci_high, 4),
+                  "overrides": srv.metrics.quality_guard_overrides,
+                  "drift_psi": parsed["raft_quality_drift_psi"][0][1]}))
+PY
+run_step quality_drill  900 python "$LOG/quality_drill_smoke.py"
+# telemetry overhead on hardware, now including the quality-sampler arm
+run_step obs_overhead_r13 1800 python bench/obs_overhead.py
+# serve bench rides along for the Prometheus surface under real load
+run_step serve_bench   3000 python bench/serve.py
+run_step bench         4500 python bench.py
+echo "$(date) all steps attempted" >> "$LOG/driver.log"

@@ -1,0 +1,436 @@
+"""Search-quality telemetry tests (ISSUE 11).
+
+All tier-1 (CPU, fast).  The quality contract under test:
+
+* the shadow-sampling oracle is EXACT: its top-k agrees with
+  ``brute_force.knn`` over the same stored vectors, for every family's
+  corpus extraction (including tombstone exclusion);
+* sampling is deterministic (seeded hash over the request sequence) and
+  the work queue is bounded — overflow drops and counts, never blocks;
+* Wilson intervals are honest at small n / extreme p;
+* index-health gauges expose occupancy imbalance / dead fraction /
+  graph-degree stats per generation, pruned to the newest K;
+* the PSI drift detector separates same-distribution from shifted;
+* ACCEPTANCE — the injected-regression drill runs deterministically:
+  recall drop at the degraded level → estimator CI below the floor →
+  recall SLO burn-rate alert → degradation guard refuses the level,
+  each step visible in the Prometheus exposition (parse_text
+  round-trip);
+* the stall-dump quarantine obeys the newest-K retention policy.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+
+from raft_tpu.neighbors import brute_force, ivf_flat, mutation
+from raft_tpu.neighbors.health import export_index_health, index_health
+from raft_tpu.obs import (DriftDetector, MetricRegistry, QualityConfig,
+                          RecallEstimator, SloEvaluator, SloPolicy,
+                          SpanRecorder, parse_text, wilson_interval)
+from raft_tpu.obs.quality import oracle_database
+from raft_tpu.serve import SearchServer, ServerConfig, ServingMetrics
+
+N, D, K = 900, 24, 8
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture(scope="module")
+def db():
+    return np.random.default_rng(11).standard_normal((N, D)).astype(
+        np.float32)
+
+
+@pytest.fixture(scope="module")
+def ivf(db):
+    return ivf_flat.build(db, ivf_flat.IvfFlatIndexParams(
+        n_lists=32, kmeans_n_iters=4, seed=3))
+
+
+# ---------------------------------------------------------------------------
+# wilson intervals
+
+
+def test_wilson_interval_honest_at_extremes():
+    lo, hi = wilson_interval(95, 100)
+    assert lo < 0.95 < hi
+    # perfect observed recall still admits doubt at small n ...
+    lo1, hi1 = wilson_interval(10, 10)
+    assert hi1 == 1.0 and lo1 < 1.0
+    # ... and the doubt shrinks with evidence
+    lo2, _ = wilson_interval(1000, 1000)
+    assert lo2 > lo1
+    assert wilson_interval(0, 0) == (0.0, 1.0)
+    lo3, hi3 = wilson_interval(0, 20)
+    assert lo3 == 0.0 and 0.0 < hi3 < 0.5
+
+
+# ---------------------------------------------------------------------------
+# the exact oracle
+
+
+def test_oracle_matches_brute_force_knn(db):
+    est = RecallEstimator(db, K, QualityConfig(rows_cap=16),
+                          registry=MetricRegistry())
+    q = db[100:116] + 0.01
+    oids = est.oracle_ids(q)
+    _, ref = brute_force.knn(q, db, K)
+    assert np.array_equal(np.sort(oids, axis=1),
+                          np.sort(np.asarray(jax.device_get(ref)), axis=1))
+
+
+def test_oracle_corpus_per_family(db, ivf):
+    vecs, ids = oracle_database(db)
+    assert vecs.shape == (N, D) and np.array_equal(ids, np.arange(N))
+    vecs, ids = oracle_database(ivf)
+    assert vecs.shape[0] == N and sorted(ids) == list(range(N))
+    # ivf oracle ranks like the brute oracle over the same stored vectors
+    est = RecallEstimator(ivf, K, QualityConfig(rows_cap=4),
+                          registry=MetricRegistry())
+    q = db[:4]
+    _, ref = brute_force.knn(q, db, K)
+    assert np.array_equal(np.sort(est.oracle_ids(q), axis=1),
+                          np.sort(np.asarray(jax.device_get(ref)), axis=1))
+
+
+def test_oracle_excludes_tombstoned_ids(db, ivf):
+    q = db[:2]
+    _, ref = brute_force.knn(q, db, K)
+    doomed = np.unique(np.asarray(jax.device_get(ref)).reshape(-1))[:5]
+    t = mutation.delete(ivf, doomed)
+    est = RecallEstimator(t, K, QualityConfig(rows_cap=2),
+                          registry=MetricRegistry())
+    oids = est.oracle_ids(q)
+    assert not (set(oids.reshape(-1).tolist()) & set(doomed.tolist()))
+
+
+# ---------------------------------------------------------------------------
+# sampling determinism + bounded queue
+
+
+def test_sampling_is_deterministic_and_seeded(db):
+    def selections(seed, fraction, n=4000):
+        est = RecallEstimator(db, K, QualityConfig(
+            sample_fraction=fraction, seed=seed),
+            registry=MetricRegistry())
+        return [est._selected(i) for i in range(n)]
+
+    a = selections(seed=1, fraction=0.05)
+    assert a == selections(seed=1, fraction=0.05)      # replayable
+    assert a != selections(seed=2, fraction=0.05)      # seed matters
+    assert sum(a) / len(a) == pytest.approx(0.05, abs=0.02)
+    assert all(selections(seed=1, fraction=1.0))
+
+
+def test_bounded_queue_drops_and_counts(db):
+    metrics = ServingMetrics()
+    est = RecallEstimator(db, K, QualityConfig(
+        sample_fraction=1.0, queue_max=2, rows_cap=2),
+        registry=metrics.registry, metrics=metrics)
+    ids = np.zeros((2, K), dtype=np.int32)
+    enqueued = sum(est.maybe_sample(db[:2], ids, level=0) for _ in range(5))
+    assert enqueued == 2                   # queue bound respected
+    assert metrics.quality_samples == 2
+    assert metrics.quality_sample_drops == 3
+    assert est.drain() == 2                # drops never reach the oracle
+
+
+def test_estimator_thread_lifecycle(db):
+    est = RecallEstimator(db, K, QualityConfig(
+        sample_fraction=1.0, rows_cap=2), registry=MetricRegistry())
+    import time
+
+    _, i = brute_force.knn(db[:2], db, K)
+    with est:
+        est.maybe_sample(db[:2], np.asarray(jax.device_get(i)), level=0)
+        for _ in range(500):
+            if est.estimate(0).samples:
+                break
+            time.sleep(0.01)
+    assert est.estimate(0).samples == 1
+    assert est.estimate(0).mean == 1.0     # self-queries, exact serving
+
+
+# ---------------------------------------------------------------------------
+# index health
+
+
+def test_index_health_per_family(db, ivf):
+    h = index_health(db)
+    assert h["family"] == "brute_force" and h["rows"] == N
+    assert h["dead_fraction"] == 0.0
+
+    h = index_health(ivf)
+    assert h["family"] == "ivf_flat" and h["rows"] == N
+    assert h["lists"] == 32 and h["occupancy_cv"] >= 0.0
+    assert 1.0 / 32 <= h["occupancy_max_fraction"] <= 1.0
+    assert 0.0 < h["occupancy_max"] <= 1.0
+
+    t = mutation.delete(ivf, np.arange(90))
+    h = index_health(t)
+    assert h["dead"] == 90
+    assert h["dead_fraction"] == pytest.approx(0.1)
+
+    from raft_tpu.neighbors import cagra
+
+    g = cagra.build(db[:256], cagra.CagraIndexParams(
+        intermediate_graph_degree=16, graph_degree=8))
+    h = index_health(g)
+    assert h["family"] == "cagra" and h["graph_degree"] == 8
+    assert h["rows"] == 256 and h["in_degree_cv"] >= 0.0
+    assert 0.0 <= h["orphan_fraction"] < 1.0
+    assert 0.0 <= h["self_loop_fraction"] <= 1.0
+
+
+def test_export_index_health_prunes_old_generations(ivf):
+    reg = MetricRegistry()
+    for gen in range(6):
+        export_index_health(reg, ivf, generation=gen, keep_generations=3)
+    gens = {labels["generation"]
+            for labels, _ in reg.get("raft_index_health").samples()}
+    assert gens == {"3", "4", "5"}
+
+
+def test_compaction_stats_ride_shared_health(db, ivf):
+    srv = SearchServer(mutation.delete(ivf, np.arange(90)), k=K,
+                       clock=FakeClock(), recorder=SpanRecorder(32))
+    from raft_tpu.serve import CompactionScheduler
+
+    s = CompactionScheduler(srv).stats()
+    assert s["rows"] == N and s["dead"] == 90
+    assert s["dead_fraction"] == pytest.approx(0.1)
+    assert 0.0 < s["occupancy"] <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# drift
+
+
+def test_drift_detector_stable_vs_shifted(db):
+    reg = MetricRegistry()
+    dd = DriftDetector.from_index(db, db[:400], registry=reg)
+    dd.observe_queries(db[400:800])        # same distribution
+    assert dd.psi() < 0.1 and dd.status() == "stable"
+    assert reg.get("raft_quality_drift_psi").value() == pytest.approx(
+        dd.psi())
+    dd.observe_queries(db[400:800] + 8.0)  # gross covariate shift
+    assert dd.psi() >= 0.25 and dd.status() == "shifted"
+
+
+def test_drift_baseline_validation():
+    from raft_tpu.core.errors import RaftError
+
+    with pytest.raises(RaftError):
+        DriftDetector([1.0], registry=MetricRegistry())
+    dd = DriftDetector(np.ones(64), registry=MetricRegistry())
+    assert dd.psi() == 0.0                 # empty window: no verdict
+    with pytest.raises(RaftError):
+        dd.observe_queries(np.ones((2, 4)))  # no reference points
+
+
+# ---------------------------------------------------------------------------
+# SLO burn rates
+
+
+def _slo_fixture(**kw):
+    metrics = ServingMetrics()
+    policy = SloPolicy(latency_ms=8.0, short_window=8, long_window=32, **kw)
+    return metrics, SloEvaluator(metrics, policy=policy,
+                                 recorder=SpanRecorder(32))
+
+
+def test_latency_burn_rate_pages_and_recovers():
+    metrics, slo = _slo_fixture()
+    for _ in range(64):
+        metrics.observe_latency(1.0)
+    assert slo.evaluate()["latency"]["state"] == "ok"
+    for _ in range(64):                    # sustained target misses
+        metrics.observe_latency(50.0)
+    out = slo.evaluate()["latency"]
+    assert out["burn_short"] >= 8.0 and out["state"] == "page"
+    assert metrics.registry.get("raft_slo_alerts_total").value(
+        slo="latency", severity="page") == 1.0
+    for _ in range(64):                    # recovery resets via short window
+        metrics.observe_latency(1.0)
+    assert slo.evaluate()["latency"]["state"] == "ok"
+
+
+def test_availability_burn_counts_rejections():
+    metrics, slo = _slo_fixture()
+    for _ in range(40):
+        metrics.count("completed")
+    assert slo.evaluate()["availability"]["state"] == "ok"
+    for _ in range(40):
+        metrics.count("rejected_deadline")
+    assert slo.evaluate()["availability"]["state"] == "page"
+
+
+def test_quality_guard_passes_unknown_levels(db):
+    metrics = ServingMetrics()
+    est = RecallEstimator(db, K, QualityConfig(sample_fraction=1.0),
+                          registry=metrics.registry)
+    slo = SloEvaluator(metrics, est, SloPolicy(min_samples=4),
+                       recorder=SpanRecorder(32))
+    # no evidence anywhere: the cold ladder must still work
+    assert slo.quality_guard(2) == 2
+    assert slo.quality_guard(0) == 0
+
+
+# ---------------------------------------------------------------------------
+# ACCEPTANCE: the injected-regression drill
+
+
+@pytest.fixture(scope="module")
+def drill_db():
+    return np.random.default_rng(7).standard_normal((4000, 32)).astype(
+        np.float32)
+
+
+@pytest.fixture(scope="module")
+def drill_index(drill_db):
+    return ivf_flat.build(drill_db, ivf_flat.IvfFlatIndexParams(
+        n_lists=64, kmeans_n_iters=4))
+
+
+def _drill_server(index, clock):
+    # level 0 probes every list (exact search, recall 1); level 1's
+    # effort scale floors n_probes to 1 — a gross, *measurable* recall
+    # regression that only load (queue depth >= 4) can trigger
+    cfg = ServerConfig(ladder=(8,), max_queue=16, max_wait_ms=0.0,
+                       degrade_queue_fractions=(0.25,),
+                       degrade_effort_scales=(1.0, 0.02))
+    return SearchServer(index, k=K,
+                        params=ivf_flat.IvfFlatSearchParams(n_probes=64),
+                        config=cfg, clock=clock, recorder=SpanRecorder(512))
+
+
+def test_quality_regression_drill(drill_index, drill_db):
+    """Recall drop -> estimator CI below floor -> SLO burn-rate alert ->
+    guard refuses the level, all deterministic, each step scrapeable."""
+    db = drill_db
+    srv = _drill_server(drill_index, FakeClock())
+    est = srv.attach_quality(
+        QualityConfig(sample_fraction=1.0, rows_cap=8),
+        policy=SloPolicy(recall_floor=0.9, min_samples=4,
+                         short_window=4, long_window=8),
+        baseline_queries=db[:256])
+
+    def drive(n_parallel: int):
+        futs = [srv.submit(db[(j * 8) % 256:(j * 8) % 256 + 8])
+                for j in range(n_parallel)]
+        while srv.step():
+            pass
+        for f in futs:
+            f.result(timeout=5)
+        est.drain()
+        srv.slo.evaluate()
+
+    # phase 1 — healthy traffic, level 0 only: recall ~1, SLO ok
+    for _ in range(6):
+        drive(1)
+    healthy = est.estimate(0)
+    assert healthy.samples >= 6 and healthy.ci_low > 0.9
+    assert srv.slo.states["recall"] == "ok"
+    assert est.levels() == [0]
+
+    # phase 2 — the injected regression: saturate the queue so the
+    # ladder enters level 1, whose effort scale guts n_probes
+    drive(8)
+    bad = est.estimate(1)
+    assert bad.samples >= 4
+    assert bad.ci_high < 0.9               # estimator detected the drop
+
+    # phase 3 — the SLO enters burn-rate alerting on the recall floor,
+    # and the alert is on the scrape surface while it burns
+    assert srv.slo.states["recall"] in ("warn", "page")
+    burning = parse_text(srv.prometheus_text())
+    assert any(labels == {"slo": "recall", "window": "short"} and v >= 2.0
+               for labels, v in burning["raft_slo_burn_rate"])
+    assert any(labels["slo"] == "recall" and v >= 1.0
+               for labels, v in burning["raft_slo_state"])
+
+    # phase 4 — the guard refuses level 1 on the next pressure burst:
+    # batches dispatch at level 0 despite the saturated queue, and the
+    # recall SLO recovers because of it
+    before = dict(srv.metrics.degrade_dispatches)
+    drive(8)
+    after = srv.metrics.degrade_dispatches
+    assert after.get(1, 0) == before.get(1, 0)   # no new level-1 batches
+    assert after.get(0, 0) > before.get(0, 0)    # served at full effort
+    assert srv.metrics.quality_guard_overrides > 0
+    assert srv.slo.states["recall"] == "ok"      # the loop closed
+
+    # every step left scrapeable evidence: prometheus round-trip over
+    # the new quality / drift / SLO / health families
+    parsed = parse_text(srv.prometheus_text())
+    assert any(labels.get("level") == "1"
+               for labels, _ in parsed["raft_quality_recall_bucket"])
+    assert parsed["raft_quality_recall_ci_high"]
+    assert parsed["raft_quality_drift_psi"][0][1] < 0.25   # no query drift
+    assert any(labels["slo"] == "recall" and v >= 1.0
+               for labels, v in parsed["raft_slo_alerts_total"])
+    assert parsed["raft_serve_quality_guard_overrides_total"][0][1] > 0
+    assert any(labels.get("stat") == "occupancy_cv"
+               for labels, _ in parsed["raft_index_health"])
+    # and the JSON snapshot carries the same story
+    snap = srv.metrics_snapshot()
+    assert snap["quality"]["levels"]["1"]["ci_high"] < 0.9
+    assert snap["slo"]["overrides"] == srv.metrics.quality_guard_overrides
+
+
+def test_drill_is_deterministic(drill_index, drill_db):
+    """Two fresh runs of the drill's sampling produce identical sample
+    selections and identical per-level windows — the replayable-evidence
+    property the drill rests on."""
+    def run():
+        srv = _drill_server(drill_index, FakeClock())
+        est = srv.attach_quality(QualityConfig(sample_fraction=0.5,
+                                               rows_cap=8))
+        for j in range(8):
+            fut = srv.submit(drill_db[j * 8:(j + 1) * 8])
+            while srv.step():
+                pass
+            fut.result(timeout=5)
+        est.drain()
+        return est.stats()
+
+    assert run() == run()
+
+
+# ---------------------------------------------------------------------------
+# watchdog quarantine retention
+
+
+def test_watchdog_retention_prunes_oldest(db, tmp_path):
+    clock = FakeClock()
+    srv = SearchServer(db, k=3, config=ServerConfig(ladder=(4,)),
+                       clock=clock, recorder=SpanRecorder(32))
+    wd = srv.attach_watchdog(tmp_path, stall_timeout_s=5.0, capture_s=0.0,
+                             max_dumps=3)
+    import os
+
+    for _ in range(5):
+        srv._inflight = ("execute", clock())
+        clock.advance(10.0)
+        assert wd.check() is not None
+        srv._inflight = None
+        assert wd.check() is None          # re-arm between episodes
+    kept = sorted(os.listdir(tmp_path))
+    assert kept == ["stall-003-execute", "stall-004-execute",
+                    "stall-005-execute"]
+    assert wd.pruned_total == 2
+    assert srv.metrics.stall_dumps_pruned == 2
+    assert wd.dumps == [os.path.join(str(tmp_path), k) for k in kept]
