@@ -36,6 +36,7 @@ __all__ = [
     "single_latency",
     "sweep_ivf_flat",
     "sweep_ivf_pq",
+    "sweep_ivf_rabitq",
     "sweep_cagra",
     "best_at_recall",
 ]
@@ -181,6 +182,28 @@ def sweep_ivf_pq(index, queries, gt, k: int, probe_grid, *,
                               metric=index.metric)
 
         out.append({"n_probes": int(n_probes), **measure_point(run, gt, nq)})
+    return out
+
+
+def sweep_ivf_rabitq(index, queries, gt, k: int, probe_grid, *,
+                     rerank_k: int = 0, search_fn=None) -> List[dict]:
+    """(n_probes → recall, qps) curve for IVF-RaBitQ.  Rerank is built
+    in (``rerank_k=0`` resolves from the tuned table / heuristic), so
+    unlike ``sweep_ivf_pq`` there is no external refine stage — the
+    returned distances are already exact over the survivors."""
+    from raft_tpu.neighbors import ivf_rabitq
+
+    search_fn = search_fn or ivf_rabitq.search
+    out = []
+    nq = queries.shape[0]
+    for n_probes in probe_grid:
+        p = ivf_rabitq.IvfRabitqSearchParams(
+            n_probes=int(n_probes), rerank_k=int(rerank_k), query_chunk=0)
+        run = lambda p=p: search_fn(index, queries, k, p)
+        out.append({"n_probes": int(n_probes),
+                    "rerank_k": ivf_rabitq.resolve_rerank_k(
+                        int(rerank_k), k, int(n_probes), index.list_cap),
+                    **measure_point(run, gt, nq)})
     return out
 
 

@@ -18,7 +18,8 @@ Examples::
     python bench/ann_bench.py cagra --base synthetic:1000000x96 --k 10 \
         --sweep 32:4,64:4,64:8
 
-Index kinds: ``brute_force`` | ``ivf_flat`` | ``ivf_pq`` | ``cagra``.
+Index kinds: ``brute_force`` | ``ivf_flat`` | ``ivf_pq`` | ``ivf_rabitq``
+| ``cagra``.
 Every result line carries the config; the last line is a summary with the
 best QPS at ``--recall-floor`` (default 0.95).
 """
@@ -45,7 +46,7 @@ pin_backend()
 import numpy as np
 
 from ann import (best_at_recall, ground_truth, make_clustered, measure_point,
-                 sweep_cagra, sweep_ivf_flat, sweep_ivf_pq)
+                 sweep_cagra, sweep_ivf_flat, sweep_ivf_pq, sweep_ivf_rabitq)
 
 
 def parse_synthetic(spec: str):
@@ -108,7 +109,8 @@ def load_gt(spec, queries, base, k, metric):
 
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    ap.add_argument("index", choices=["brute_force", "ivf_flat", "ivf_pq", "cagra"])
+    ap.add_argument("index", choices=["brute_force", "ivf_flat", "ivf_pq",
+                                      "ivf_rabitq", "cagra"])
     ap.add_argument("--base", required=True, help="dataset file or synthetic:NxD")
     ap.add_argument("--query", default=None, help="query file (default: synthetic held-out / first 10k rows)")
     ap.add_argument("--gt", default=None, help="ground-truth ids file (default: computed exactly)")
@@ -120,6 +122,9 @@ def main() -> None:
     ap.add_argument("--pack-codes", action="store_true",
                     help="4-bit packed code storage (requires --pq-bits<=4)")
     ap.add_argument("--refine", type=int, default=4, help="ivf_pq refine ratio (0 = off)")
+    ap.add_argument("--rerank-k", type=int, default=0,
+                    help="ivf_rabitq exact-rerank pool (0 = tuned table / "
+                         "heuristic)")
     ap.add_argument("--graph-degree", type=int, default=32)
     ap.add_argument("--sweep", default=None,
                     help="ivf: probe list '8,16,32'; cagra: 'itopk:width,...'")
@@ -189,7 +194,7 @@ def main() -> None:
         run = lambda: brute_force.knn(q, base, args.k, metric=args.metric,
                                       mode="fast")
         curve = [{"mode": "fast", **measure_point(run, gt, q.shape[0])}]
-    elif args.index in ("ivf_flat", "ivf_pq"):
+    elif args.index in ("ivf_flat", "ivf_pq", "ivf_rabitq"):
         mod = __import__(f"raft_tpu.neighbors.{args.index}",
                          fromlist=[args.index])
         if args.index == "ivf_pq":
@@ -198,6 +203,11 @@ def main() -> None:
                                      pq_bits=args.pq_bits,
                                      pack_codes=args.pack_codes,
                                      metric=args.metric)
+        elif args.index == "ivf_rabitq":
+            if mesh is not None:
+                raise SystemExit("--sharded: ivf_rabitq is single-device "
+                                 "for now (use ivf_flat/ivf_pq/cagra)")
+            p = mod.IvfRabitqIndexParams(n_lists=n_lists, metric=args.metric)
         else:
             p = mod.IvfFlatIndexParams(n_lists=n_lists, metric=args.metric)
         if mesh is not None:
@@ -216,6 +226,9 @@ def main() -> None:
                 index, q, gt, args.k, probes,
                 refine_dataset=(base if args.refine and mesh is None else None),
                 refine_ratio=max(args.refine, 1), search_fn=search_fn)
+        elif args.index == "ivf_rabitq":
+            curve = sweep_ivf_rabitq(index, q, gt, args.k, probes,
+                                     rerank_k=args.rerank_k)
         else:
             curve = sweep_ivf_flat(index, q, gt, args.k, probes,
                                    search_fn=search_fn)

@@ -2,7 +2,9 @@
 
 Measures the streaming phase of ``build_chunked`` (training excluded —
 it is byte-identical in both engines) as rows/s, plus end-to-end
-time-to-index, for both IVF families:
+time-to-index, for the three IVF families (ivf_flat, ivf_pq,
+ivf_rabitq — the RaBitQ encode is codebook-free, so its stream is the
+flat pipeline plus one rotation einsum + sign-pack per chunk):
 
 * **perop** — the pre-pipelining reference loop kept verbatim as
   ``_stream_perop`` / ``_pq_stream_perop``: blocking ``jnp.asarray``
@@ -49,7 +51,7 @@ import time
 import numpy as np
 
 from _timing import sync, timeit
-from raft_tpu.neighbors import ivf_flat, ivf_pq
+from raft_tpu.neighbors import ivf_flat, ivf_pq, ivf_rabitq
 
 # (family, rows, dim, n_lists, chunk_rows): the 1M acceptance point runs
 # at a small chunk size — the dispatch-bound regime the fusion targets
@@ -64,9 +66,12 @@ GRID = [
     ("ivf_flat", 1_000_000, 64, 64, 65536),
     ("ivf_pq", 1_000_000, 64, 64, 128),
     ("ivf_pq", 1_000_000, 64, 64, 65536),
+    ("ivf_rabitq", 1_000_000, 64, 64, 128),
+    ("ivf_rabitq", 1_000_000, 64, 64, 65536),
 ]
 QUICK_GRID = [("ivf_flat", 100_000, 64, 64, 128),
-              ("ivf_pq", 100_000, 64, 64, 128)]
+              ("ivf_pq", 100_000, 64, 64, 128),
+              ("ivf_rabitq", 100_000, 64, 64, 128)]
 # training is byte-identical in both engines and excluded from the
 # timings — keep it short so the bench spends its budget on the streams
 TRAIN_FRACTION, TRAIN_ITERS = 0.02, 5
@@ -76,6 +81,10 @@ REPS = 3
 def _params(family: str, n_lists: int):
     if family == "ivf_flat":
         return ivf_flat.IvfFlatIndexParams(
+            n_lists=n_lists, kmeans_trainset_fraction=TRAIN_FRACTION,
+            kmeans_n_iters=TRAIN_ITERS, seed=0)
+    if family == "ivf_rabitq":
+        return ivf_rabitq.IvfRabitqIndexParams(
             n_lists=n_lists, kmeans_trainset_fraction=TRAIN_FRACTION,
             kmeans_n_iters=TRAIN_ITERS, seed=0)
     return ivf_pq.IvfPqIndexParams(
@@ -97,6 +106,17 @@ def _streams(family: str, x, p, chunk_rows: int):
             x, cents, p, n, cap, chunk_rows, None, dt)
         pipe = lambda: ivf_flat._stream_pipelined(
             x, cents, p, n, cap, chunk_rows, None, dt)
+        return perop, pipe
+    if family == "ivf_rabitq":
+        cap = max(1, int(np.ceil(p.list_cap_ratio * n / p.n_lists)))
+        cents = ivf_flat._coarse_train_chunked(x, p, n)
+        rot = ivf_rabitq._rotation(d, p.seed)
+        sync((cents, rot))
+        dt = cents.dtype
+        perop = lambda: ivf_rabitq._stream_perop(
+            x, cents, rot, p, n, cap, chunk_rows, None, dt)
+        pipe = lambda: ivf_rabitq._stream_pipelined(
+            x, cents, rot, p, n, cap, chunk_rows, None, dt)
         return perop, pipe
     m = p.pq_dim
     cap = max(1, int(np.ceil(p.list_cap_ratio * n / p.n_lists)))
@@ -125,8 +145,9 @@ def main() -> None:
         perop, pipe = _streams(family, x, p, chunk_rows)
         t_perop = timeit(perop, REPS)
         t_pipe = timeit(pipe, REPS)
-        build = (ivf_flat.build_chunked if family == "ivf_flat"
-                 else ivf_pq.build_chunked)
+        build = {"ivf_flat": ivf_flat.build_chunked,
+                 "ivf_pq": ivf_pq.build_chunked,
+                 "ivf_rabitq": ivf_rabitq.build_chunked}[family]
         t0 = time.perf_counter()
         sync(build(x, p, chunk_rows=chunk_rows))
         tti = time.perf_counter() - t0

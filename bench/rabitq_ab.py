@@ -1,0 +1,189 @@
+"""RaBitQ vs IVF-PQ A/B — the ISSUE 13 acceptance artifact.
+
+Two claims, measured on one clustered 200k×64 corpus (the
+``bench/ann.py`` surrogate protocol, same timing/sync discipline):
+
+* **search** — the rabitq 1-bit estimator scan + exact rerank beats the
+  ivf_pq recon tier's QPS at matched recall@10 ≥ 0.95.  The rabitq arm
+  sweeps ``n_probes`` × ``rerank_k``; the pq side sweeps the recon tier
+  AND two ``refine`` serving setups (ratio 8/16 — the recon tier alone
+  saturates near recall 0.57 on clustered data, so the refine arms are
+  what gives pq a fighting chance at the floor), and the best pq point
+  across ALL arms is the baseline — an honest comparison, not a
+  strawman.
+* **build** — the codebook-free rabitq build moves more rows/s than
+  ``ivf_pq.build`` under identical coarse-training settings (no PQ
+  sub-kmeans, no code assignment sweep).
+
+Memory at rest is matched within ~25 %: rabitq stores d/8 = 8 B codes
++ 12 B correction scalars per vector (20 B) vs pq_dim=16 × 8-bit codes
+(16 B); both serving setups additionally keep raw vectors for the
+exact stage (rabitq's rerank slab / pq's refine dataset).  Per-vector
+bytes ride the artifact so the trade is explicit.
+
+    python bench/rabitq_ab.py [--quick] [--cpu]
+
+Writes ``bench/RABITQ_<BACKEND>.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.expanduser("~/.cache/raft_tpu_jax"))
+
+import jax
+
+from _platform import pin_backend
+
+# MUST precede any backend use (see _platform.py: the axon plugin's
+# sitecustomize overrides a bare JAX_PLATFORMS env var)
+pin_backend(sys.argv)
+
+import numpy as np
+
+from ann import (best_at_recall, default_n_lists, ground_truth,
+                 make_clustered, sweep_ivf_pq, sweep_ivf_rabitq)
+from raft_tpu.neighbors import ivf_pq, ivf_rabitq
+
+ROWS, DIM, NQ, K = 200_000, 64, 2000, 10
+QUICK_ROWS = 20_000
+RECALL_FLOOR = 0.95
+PQ_DIM, PQ_BITS = 16, 8
+PROBE_GRID = [4, 8, 16, 32]
+# 0 = the tuned-table/heuristic default; the wider widths trade exact-
+# gather rows for probes (rerank_k is the cheaper recall dial — see
+# docs/tuning_guide.md)
+RERANK_GRID = [0, 160, 320]
+REFINE_RATIOS = [8, 16]
+# identical coarse-training budget for the build race
+TRAIN_FRACTION, TRAIN_ITERS = 0.05, 10
+
+
+def _bytes_per_vector(d: int) -> dict:
+    return {
+        "rabitq_codes": d // 8,
+        "rabitq_correction_scalars": 12,          # sabs + res_norm + cdot f32
+        "rabitq_total_quantized": d // 8 + 12,
+        "pq_codes": PQ_DIM * PQ_BITS // 8,
+        "raw_rerank_row_f32": 4 * d,              # both serving setups
+    }
+
+
+def main() -> None:
+    quick = "--quick" in sys.argv
+    rows = QUICK_ROWS if quick else ROWS
+    backend = jax.default_backend()
+    n_clusters = max(64, rows // 1000)
+    x = make_clustered(rows, DIM, n_clusters, seed=0, scale=2.0)
+    q = make_clustered(NQ, DIM, n_clusters, seed=0, scale=2.0, point_seed=1)
+    gt = ground_truth(q, x, K)
+    n_lists = default_n_lists(rows)
+
+    # --- build race (end-to-end build(), identical coarse training) ---
+    rp = ivf_rabitq.IvfRabitqIndexParams(
+        n_lists=n_lists, kmeans_trainset_fraction=TRAIN_FRACTION,
+        kmeans_n_iters=TRAIN_ITERS, seed=0)
+    pp = ivf_pq.IvfPqIndexParams(
+        n_lists=n_lists, pq_dim=PQ_DIM, pq_bits=PQ_BITS,
+        kmeans_trainset_fraction=TRAIN_FRACTION,
+        kmeans_n_iters=TRAIN_ITERS, seed=0)
+
+    def _timed_build(build, p):
+        t0 = time.perf_counter()
+        index = build(x, p)
+        jax.block_until_ready(index.counts)
+        return index, time.perf_counter() - t0
+
+    # warm both builder programs once so the race times steady-state
+    # streaming, not first-call compilation (both arms get the same deal)
+    warm_rows = min(rows, 20_000)
+    _timed_build(lambda xx, p: ivf_rabitq.build(x[:warm_rows], p), rp)
+    _timed_build(lambda xx, p: ivf_pq.build(x[:warm_rows], p), pp)
+    rq_index, rq_build_s = _timed_build(ivf_rabitq.build, rp)
+    pq_index, pq_build_s = _timed_build(ivf_pq.build, pp)
+    build = {
+        "rows": rows, "n_lists": n_lists,
+        "train_fraction": TRAIN_FRACTION, "train_iters": TRAIN_ITERS,
+        "rabitq_s": round(rq_build_s, 3),
+        "ivf_pq_s": round(pq_build_s, 3),
+        "rabitq_rows_per_s": round(rows / rq_build_s),
+        "ivf_pq_rows_per_s": round(rows / pq_build_s),
+        "speedup": round(pq_build_s / rq_build_s, 3),
+    }
+    print(json.dumps({"build": build}), flush=True)
+
+    # --- search race -------------------------------------------------
+    rq_curve = []
+    for rk in RERANK_GRID:
+        for pt in sweep_ivf_rabitq(rq_index, q, gt, K, PROBE_GRID,
+                                   rerank_k=rk):
+            rq_curve.append(pt)
+            print(json.dumps({"config": "ivf_rabitq", **pt}), flush=True)
+    pq_recon = sweep_ivf_pq(pq_index, q, gt, K, PROBE_GRID)
+    for pt in pq_recon:
+        print(json.dumps({"config": "ivf_pq_recon", **pt}), flush=True)
+    pq_refine = []
+    for ratio in REFINE_RATIOS:
+        for pt in sweep_ivf_pq(pq_index, q, gt, K, PROBE_GRID,
+                               refine_dataset=x, refine_ratio=ratio):
+            pq_refine.append(dict(pt, refine_ratio=ratio))
+            print(json.dumps({"config": f"ivf_pq_recon_refine{ratio}",
+                              **pt}), flush=True)
+
+    rq_best = best_at_recall(rq_curve, RECALL_FLOOR)
+    pq_recon_best = best_at_recall(pq_recon, RECALL_FLOOR)
+    pq_bests = [b for b in (pq_recon_best,
+                            best_at_recall(pq_refine, RECALL_FLOOR))
+                if b is not None]
+    pq_best = max(pq_bests, key=lambda b: b["qps"]) if pq_bests else None
+
+    # the ISSUE baseline is the recon tier; the committed claim is the
+    # stronger one — faster than the best pq arm that reaches the floor
+    # at all (a baseline that never reaches the floor loses by DNF)
+    qps_ok = (rq_best is not None
+              and (pq_best is None or rq_best["qps"] > pq_best["qps"]))
+    build_ok = build["rabitq_rows_per_s"] >= build["ivf_pq_rows_per_s"]
+    out = {
+        "bench": "rabitq_ab",
+        "backend": backend,
+        "mode": "quick" if quick else "full",
+        "dataset": {"rows": rows, "dim": DIM, "queries": NQ, "k": K,
+                    "n_clusters": n_clusters, "clustered": True},
+        "recall_floor": RECALL_FLOOR,
+        "bytes_per_vector": _bytes_per_vector(DIM),
+        "build": build,
+        "search": {
+            "ivf_rabitq": rq_curve,
+            "ivf_pq_recon": pq_recon,
+            "ivf_pq_recon_refine": pq_refine,
+        },
+        "best_at_floor": {
+            "ivf_rabitq": rq_best,
+            "ivf_pq": pq_best,
+            "ivf_pq_recon_only": pq_recon_best,
+            "pq_recon_reaches_floor": pq_recon_best is not None,
+        },
+        "acceptance": {
+            "rabitq_qps_beats_pq_at_floor": qps_ok,
+            "rabitq_build_rows_per_s_ge_pq": build_ok,
+        },
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        f"RABITQ_{backend.upper()}.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {path}")
+    print(json.dumps({"acceptance": out["acceptance"],
+                      "best": out["best_at_floor"]}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

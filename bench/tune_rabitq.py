@@ -1,0 +1,181 @@
+"""Offline RaBitQ search tuning — pick ``(rerank_k, probe_block)`` per
+``(k, n_probes, list cap)`` bucket, the recall-gated sibling of
+``bench/tune_probe_block.py``.
+
+Unlike ``probe_block`` (bit-identical at every value), ``rerank_k``
+changes RESULTS: it gates which candidates reach the exact rerank, so
+the knob must be tuned against a recall target, not wall-clock alone
+(the ``resolve_cagra_search`` model).  Per bucket:
+
+1. measure the bucket's recall *ceiling* — ``rerank_k`` = everything
+   probed (the estimator then only orders the exact rerank's input, so
+   the ceiling is the probe-coverage recall);
+2. pick the smallest power-of-two-ish ``rerank_k`` whose recall is
+   within ``GATE`` of that ceiling (coverage losses don't count against
+   the estimator);
+3. at that ``rerank_k``, pick ``probe_block`` by pure wall-clock.
+
+Run on the target backend (real TPU for production numbers):
+
+    python bench/tune_rabitq.py [--quick] [--cpu]
+
+Writes ``raft_tpu/neighbors/_rabitq_tune_table.json`` (or the
+``.{backend}.json`` variant off-TPU) keyed
+``ivf_rabitq:k.bit_length():n_probes.bit_length():cap.bit_length()``
+with ``{"rerank_k": R, "probe_block": B}`` entries —
+``resolve_rerank_k`` / ``_resolve_probe_block`` consult it at call time
+(``kernel_sha``-scoped: a table measured against older scan sources is
+ignored).  A ``.meta.json`` sidecar records provenance.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.expanduser("~/.cache/raft_tpu_jax"))
+
+import jax
+
+from _platform import pin_backend
+
+# MUST precede any backend use (see tune_select_k.py: the axon plugin's
+# sitecustomize overrides a bare JAX_PLATFORMS env var)
+pin_backend(sys.argv)
+
+import numpy as np
+
+from _timing import timeit as _time
+from ann import ground_truth, make_clustered
+from raft_tpu.neighbors import ivf_rabitq
+from raft_tpu.ops.blocked_scan import scan_kernel_sha
+from raft_tpu.stats import neighborhood_recall
+
+ROWS, DIM, NQ, K = 120_000, 64, 256, 10
+QUICK_ROWS = 30_000                       # smoke the machinery, not the numbers
+BLOCK_CANDIDATES = [1, 2, 4, 8, 16]
+# smallest rerank_k within GATE of the bucket's own probe-coverage
+# ceiling wins — an absolute floor would conflate estimator quality with
+# how many lists the bucket probes
+GATE = 0.005
+# rerank everything probed IS the ceiling definition, but past a few
+# thousand rows the estimator's ordering is long since saturated and the
+# exact-gather cost explodes (64 probes × cap 1407 ≈ 90k rows/query) —
+# cap the ceiling measurement where the curve is provably flat
+CEILING_CAP = 4096
+CONFIGS = [(512, [8, 16, 64]), (128, [8, 16]), (32, [8, 16])]
+QUICK_CONFIGS = [(512, [16, 64]), (128, [64])]
+
+
+def bucket_key(k: int, n_probes: int, cap: int) -> str:
+    """Must mirror ``ivf_rabitq._tune_entry``'s key scheme exactly."""
+    return f"ivf_rabitq:{k.bit_length()}:{n_probes.bit_length()}" \
+           f":{cap.bit_length()}"
+
+
+def _rerank_grid(k: int, total: int):
+    out, r = [], max(32, 2 * k)
+    while r < total:
+        out.append(r)
+        r *= 2
+    out.append(total)
+    return out
+
+
+def main() -> None:
+    quick = "--quick" in sys.argv
+    configs = QUICK_CONFIGS if quick else CONFIGS
+    rows = QUICK_ROWS if quick else ROWS
+    sha = scan_kernel_sha()
+    backend = jax.default_backend()
+
+    rng = np.random.default_rng(0)
+    x = jax.device_put(np.asarray(make_clustered(
+        rows, DIM, max(64, rows // 1000), seed=0, scale=2.0)))
+    q = jax.device_put(np.asarray(make_clustered(
+        NQ, DIM, max(64, rows // 1000), seed=0, scale=2.0, point_seed=1)))
+    del rng
+    gt = ground_truth(q, x, K)
+
+    entries: dict = {}
+    timings: dict = {}
+    for n_lists, probe_grid in configs:
+        index = ivf_rabitq.build(x, ivf_rabitq.IvfRabitqIndexParams(
+            n_lists=n_lists, list_cap_ratio=1.5,
+            kmeans_trainset_fraction=0.05, seed=0))
+        cap = index.list_cap
+        for n_probes in probe_grid:
+            total = min(n_probes * cap, CEILING_CAP)
+
+            def recall_at(rk: int) -> float:
+                p = ivf_rabitq.IvfRabitqSearchParams(
+                    n_probes=n_probes, rerank_k=rk)
+                _, ids = ivf_rabitq.search(index, q, K, p)
+                return float(neighborhood_recall(np.asarray(ids), gt))
+
+            ceiling = recall_at(total)
+            grid = _rerank_grid(K, total)
+            chosen, curve = total, {}
+            for rk in grid:
+                r = recall_at(rk)
+                curve[str(rk)] = round(r, 4)
+                if r >= ceiling - GATE:
+                    chosen = rk
+                    break
+            best_b, best_t, tcurve = 1, float("inf"), {}
+            for pb in BLOCK_CANDIDATES:
+                if pb > n_probes:
+                    continue
+                p = ivf_rabitq.IvfRabitqSearchParams(
+                    n_probes=n_probes, rerank_k=chosen, probe_block=pb)
+                t = _time(lambda p=p: ivf_rabitq.search(index, q, K, p))
+                tcurve[str(pb)] = t
+                if t < best_t:
+                    best_b, best_t = pb, t
+            key = bucket_key(K, n_probes, cap)
+            entries[key] = {"rerank_k": int(chosen), "probe_block": best_b}
+            timings[key] = {"n_lists": n_lists, "cap": cap,
+                            "n_probes": n_probes, "ceiling": round(ceiling, 4),
+                            "recall_curve": curve, "block_curve_s": tcurve}
+            print(f"n_lists={n_lists:4d} cap={cap:5d} p={n_probes:3d} → "
+                  f"rerank_k={chosen} (ceiling {ceiling:.4f}) "
+                  f"B={best_b} ({best_t * 1e3:.1f} ms)")
+
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                       "raft_tpu", "neighbors", "_rabitq_tune_table.json")
+    if backend != "tpu" and "--force" not in sys.argv:
+        # an off-TPU run must never clobber the table the TPU search
+        # paths consult (same rule as the probe_block tuner)
+        out = out.replace(".json", f".{backend}.json")
+        print(f"non-TPU backend: writing to {os.path.basename(out)} "
+              f"(--force overrides)", file=sys.stderr)
+    with open(out, "w") as f:
+        json.dump({"kernel_sha": sha, "entries": entries}, f,
+                  indent=1, sort_keys=True)
+        f.write("\n")
+
+    import datetime
+
+    with open(out.replace(".json", ".meta.json"), "w") as f:
+        json.dump({"backend": backend,
+                   "date": datetime.date.today().isoformat(),
+                   "kernel_sha": sha,
+                   "gate": GATE,
+                   "rows": rows, "dim": DIM, "nq": NQ, "k": K,
+                   "n_entries": len(entries),
+                   "timings": timings}, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {len(entries)} entries → {os.path.normpath(out)}")
+
+    # the auto path must be able to see what we just measured
+    ivf_rabitq._rabitq_tune_table.cache_clear()
+    r = ivf_rabitq.resolve_rerank_k(0, K, 64, 512)
+    assert r >= K
+
+
+if __name__ == "__main__":
+    main()

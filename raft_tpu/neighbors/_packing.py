@@ -29,10 +29,7 @@ __all__ = ["pack_lists", "chunked_queries", "chunked_filtered_queries",
            "chunked_shard_rows", "chunked_shard_trainsets",
            "blocked_probe_plan", "resolve_probe_block",
            "resolve_chunk_rows", "resolve_cagra_search",
-           "DEFAULT_INSERT_CHUNK", "host_rows", "staged_insert_chunks",
-           # re-exports from ops.blocked_scan (the scoring-tier rule moved
-           # to the scan core; existing call sites keep this import path)
-           "exact_gathered_dots", "int8_tier_eligible"]
+           "DEFAULT_INSERT_CHUNK", "host_rows", "staged_insert_chunks"]
 
 
 def prefetch_chunks(dataset, chunk_rows: int, ids=None):
@@ -230,13 +227,9 @@ def check_filter_covers_ids(keep, ids):
             f"filter covers {keep.shape[-1]} ids, index ids reach {max_id}")
 
 
-# the scoring-tier rule and the gathered-dots einsum moved to the shared
-# blocked-scan core (ops must not import neighbors); re-exported here for
-# the existing call sites and tests
-from ..ops.blocked_scan import (  # noqa: E402
-    exact_gathered_dots as exact_gathered_dots,
-    int8_tier_eligible as int8_tier_eligible,
-)
+# NOTE: the scoring-tier rule (int8_tier_eligible) and the gathered-dots
+# einsum live in ops.blocked_scan's documented quantized-scan sub-API —
+# import them from there (the historical _packing re-exports are gone).
 
 
 def keep_lookup(keep, vids):

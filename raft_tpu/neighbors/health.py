@@ -23,6 +23,9 @@ Per family:
   (``code_norms``) over live slots — decoded-residual energy is the
   reconstruction-error proxy available without re-reading raw vectors,
   and its drift across generations tracks codebook staleness.
+* **ivf_rabitq** — same occupancy stats, plus mean / p95 of the stored
+  residual energy ``‖x−c‖²`` over live slots (the 1-bit estimator's
+  error scale) — drift tracks centroid staleness.
 * **cagra** — in-degree distribution of the fixed-out-degree graph
   (CV, max in-degree fraction, orphan fraction — orphans are
   unreachable except through seeds), self-loop fraction.
@@ -92,6 +95,19 @@ def index_health(index) -> dict:
                 float((graph == np.arange(n)[:, None]).sum()) / graph.size
                 if graph.size else 0.0,
         }
+    elif hasattr(index, "rotation"):                   # ivf_rabitq
+        counts = np.asarray(jax.device_get(index.counts))  # jaxlint: disable=JX01 build/swap-time health poll, never on the search path
+        rn2 = np.asarray(jax.device_get(index.res_norms))  # jaxlint: disable=JX01 build/swap-time health poll, never on the search path
+        ids = np.asarray(jax.device_get(index.ids))  # jaxlint: disable=JX01 build/swap-time health poll, never on the search path
+        live = rn2[ids >= 0]
+        out = {"family": "ivf_rabitq", "rows": float(counts.sum())}
+        out.update(_occupancy_stats(counts, index.list_cap))
+        # ‖x−c‖² over live slots: the estimator's error scale is
+        # proportional to residual energy, so drift across generations
+        # tracks centroid staleness exactly like ivf_pq's decoded proxy
+        out["residual_energy_mean"] = float(live.mean()) if live.size else 0.0
+        out["residual_energy_p95"] = \
+            float(np.percentile(live, 95)) if live.size else 0.0
     elif hasattr(index, "codes"):                      # ivf_pq
         counts = np.asarray(jax.device_get(index.counts))  # jaxlint: disable=JX01 build/swap-time health poll, never on the search path
         norms = np.asarray(jax.device_get(index.code_norms))  # jaxlint: disable=JX01 build/swap-time health poll, never on the search path

@@ -359,21 +359,12 @@ def _adc_tables(codes, centroids, codebooks, code_norms):
     return clut, anorms.reshape(-1, cap)[:L]
 
 
-def _pack_codes4(codes: jax.Array) -> jax.Array:
-    """Pack 4-bit sub-codes pairwise: ``[..., m] → [..., ceil(m/2)]``
-    (even positions in the low nibble).  Values must be < 16."""
-    m = codes.shape[-1]
-    if m % 2:
-        codes = jnp.pad(codes, [(0, 0)] * (codes.ndim - 1) + [(0, 1)])
-    return (codes[..., 0::2] | (codes[..., 1::2] << 4)).astype(jnp.uint8)
-
-
-def _unpack_codes4(packed: jax.Array, m: int) -> jax.Array:
-    """Inverse of :func:`_pack_codes4` for a logical width ``m``."""
-    lo = packed & 0xF
-    hi = packed >> 4
-    inter = jnp.stack([lo, hi], axis=-1).reshape(*packed.shape[:-1], -1)
-    return inter[..., :m].astype(jnp.uint8)
+# 4-bit code packing moved to the quantized-scan sub-API (shared with the
+# 1-bit RaBitQ codes); these aliases keep the historical private names
+from ..ops.blocked_scan import (  # noqa: E402
+    pack_codes4 as _pack_codes4,
+    unpack_codes4 as _unpack_codes4,
+)
 
 
 @tracing.annotate("ivf_pq.build")

@@ -3,9 +3,9 @@
 Production corpora mutate; the indexes here are built once.  This module
 closes the gap without touching any search kernel:
 
-* **insert** — the per-family ``extend()`` (ivf_flat/ivf_pq) streams new
-  rows through the slab-donating chunk step; :func:`extend` below adds a
-  tombstone-preserving dispatch over both families.
+* **insert** — the per-family ``extend()`` (ivf_flat/ivf_pq/ivf_rabitq)
+  streams new rows through the slab-donating chunk step; :func:`extend`
+  below adds a tombstone-preserving dispatch over the IVF families.
 * **delete** — :func:`delete` records dead *source ids* in a
   ``core.Bitset`` keep-mask (True = live) and wraps the untouched index
   in a :class:`Tombstoned` view.  Every family's filtered-search path
@@ -117,13 +117,16 @@ def extend(index, new_vectors, new_ids=None, *, insert_chunk: int = 0):
     the wrapped index and re-wraps with the same mask (grown — with live
     defaults — only if the new ids overflow it, which changes the mask
     shape; serving loops avoid that by sizing ``id_space`` up front)."""
-    from . import ivf_flat, ivf_pq
+    from . import ivf_flat, ivf_pq, ivf_rabitq
 
     base, keep = (index.index, index.keep) if isinstance(index, Tombstoned) \
         else (index, None)
     if isinstance(base, ivf_pq.IvfPqIndex):
         out = ivf_pq.extend(base, new_vectors, new_ids,
                             insert_chunk=insert_chunk)
+    elif isinstance(base, ivf_rabitq.IvfRabitqIndex):
+        out = ivf_rabitq.extend(base, new_vectors, new_ids,
+                                insert_chunk=insert_chunk)
     else:
         expects(isinstance(base, ivf_flat.IvfFlatIndex),
                 "online extend is an IVF-family operation (cagra/brute "
@@ -156,7 +159,7 @@ def search(t: Tombstoned, queries, k: int, params=None, *, filter=None,
     """Family-dispatched search over a tombstoned view — deleted ids never
     appear in results (empty slots report id −1 / ±inf, the filtered-
     search contract).  An extra ``filter`` is ANDed with the mask."""
-    from . import brute_force, cagra, ivf_flat, ivf_pq
+    from . import brute_force, cagra, ivf_flat, ivf_pq, ivf_rabitq
 
     expects(isinstance(t, Tombstoned), "search() takes a Tombstoned view")
     keep = _combined_keep(t.keep, filter)
@@ -165,6 +168,8 @@ def search(t: Tombstoned, queries, k: int, params=None, *, filter=None,
         return ivf_flat.search(base, queries, k, params, filter=keep, **kw)
     if isinstance(base, ivf_pq.IvfPqIndex):
         return ivf_pq.search(base, queries, k, params, filter=keep, **kw)
+    if isinstance(base, ivf_rabitq.IvfRabitqIndex):
+        return ivf_rabitq.search(base, queries, k, params, filter=keep, **kw)
     if isinstance(base, cagra.CagraIndex):
         return cagra.search(base, queries, k, params, filter=keep, **kw)
     return brute_force.knn(queries, base, k, filter=keep, **kw)
@@ -198,7 +203,7 @@ def compact(index, *, headroom: float = 2.0):
     is old row ``kept[i]`` with ``kept`` the sorted live row numbers
     (``headroom`` is meaningless, there are no lists).  Cagra has no slab
     to rewrite — rebuild it."""
-    from . import ivf_flat, ivf_pq
+    from . import ivf_flat, ivf_pq, ivf_rabitq
 
     base, keep = (index.index, index.keep) if isinstance(index, Tombstoned) \
         else (index, None)
@@ -214,7 +219,8 @@ def compact(index, *, headroom: float = 2.0):
         expects(kept.size >= 1, "compact would drop every row")
         return jnp.asarray(base)[jnp.asarray(kept, jnp.int32)]
     is_pq = isinstance(base, ivf_pq.IvfPqIndex)
-    expects(is_pq or isinstance(base, ivf_flat.IvfFlatIndex),
+    is_rabitq = isinstance(base, ivf_rabitq.IvfRabitqIndex)
+    expects(is_pq or is_rabitq or isinstance(base, ivf_flat.IvfFlatIndex),
             "compact is an IVF-family operation (plus tombstoned brute-"
             "force slabs): cagra stores rows positionally — rebuild it")
     was_packed = False
@@ -239,6 +245,22 @@ def compact(index, *, headroom: float = 2.0):
         if base.recon is not None:
             out = out.with_recon()
         return out.with_packed_codes() if was_packed else out
+    if is_rabitq:
+        # codes + correction scalars are per-row, centroid-relative — a
+        # slot keeps them verbatim through the repack (no re-encode)
+        flat = (base.codes.reshape(L * cap, -1),
+                base.sabs.reshape(L * cap),
+                base.res_norms.reshape(L * cap),
+                base.code_cdots.reshape(L * cap),
+                base.data.reshape(L * cap, -1),
+                base.ids.reshape(L * cap))
+        (codes, sabs, rn2, cs, data, ids), counts = pack_lists(
+            labels, flat, n_lists=L, cap=new_cap,
+            fills=(0, 0.0, 0.0, 0.0, 0.0, -1))
+        return ivf_rabitq.IvfRabitqIndex(
+            base.centroids, base.rotation,
+            codes.reshape(L, new_cap, -1), sabs, rn2, cs,
+            data.reshape(L, new_cap, base.dim), ids, counts, base.metric)
     flat = (base.data.reshape(L * cap, -1), base.ids.reshape(L * cap))
     (data, ids), counts = pack_lists(labels, flat, n_lists=L, cap=new_cap,
                                      fills=(0.0, -1))

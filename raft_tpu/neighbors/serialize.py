@@ -36,9 +36,9 @@ __all__ = ["save_index", "load_index", "verify_index", "index_manifest",
 # Readers accept <= _FORMAT_VERSION.  Writers stamp the LOWEST version
 # that can faithfully represent the artifact (_artifact_version), so only
 # genuinely new-format artifacts (4-bit packed codes, v2; tombstoned /
-# brute-force wrappers, v3) are rejected by older readers — everything
-# else stays interchangeable.
-_FORMAT_VERSION = 3
+# brute-force wrappers, v3; 1-bit RaBitQ sign codes, v4) are rejected by
+# older readers — everything else stays interchangeable.
+_FORMAT_VERSION = 4
 
 #: index_type names handled structurally rather than via the dataclass
 #: registry: a raw (n, d) database and the tombstoned wrapper
@@ -48,8 +48,11 @@ _KEEP_FIELD = "__keep_words"
 
 
 def _artifact_version(index) -> int:
+    from .ivf_rabitq import IvfRabitqIndex
     from .mutation import Tombstoned
 
+    if isinstance(index, IvfRabitqIndex):
+        return 4
     if isinstance(index, Tombstoned) or not hasattr(index, "metric"):
         return 3
     return 2 if getattr(index, "packed", False) else 1
@@ -59,9 +62,11 @@ def _index_registry():
     from .cagra import CagraIndex, ShardedCagraIndex
     from .ivf_flat import IvfFlatIndex
     from .ivf_pq import IvfPqIndex
+    from .ivf_rabitq import IvfRabitqIndex
 
     return {c.__name__: c for c in
-            (IvfFlatIndex, IvfPqIndex, CagraIndex, ShardedCagraIndex)}
+            (IvfFlatIndex, IvfPqIndex, IvfRabitqIndex,
+             CagraIndex, ShardedCagraIndex)}
 
 
 def _validate_meta(meta, path):
@@ -88,7 +93,9 @@ def _index_meta(index, manifest=None):
         assert _KEEP_FIELD not in arrays
         arrays[_KEEP_FIELD] = np.asarray(index.keep.words)
         meta = dict(meta, index_type=_TOMBSTONED_TYPE,
-                    format_version=3,
+                    # the wrapper needs v3; a wrapped index may need more
+                    # (RaBitQ, v4) — stamp whichever is newer
+                    format_version=max(3, meta["format_version"]),
                     tombstone={"wrapped_type": meta["index_type"],
                                "n_bits": int(index.keep.n_bits)})
         return arrays, meta

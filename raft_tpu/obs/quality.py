@@ -129,6 +129,8 @@ def oracle_database(index):
     * ``ivf_flat`` — the list slabs, flattened, pad slots dropped;
     * ``ivf_pq`` — the bf16 reconstruction slab (materialized on demand),
       so the oracle is exact over the stored representation;
+    * ``ivf_rabitq`` — the raw rerank slab (rerank returns exact
+      distances, so the oracle corpus is the raw vectors);
     * ``cagra`` — the dataset, ids = row numbers;
     * ``mutation.Tombstoned`` — the wrapped index's corpus with deleted
       source ids removed (a tombstoned id must never count as a miss
@@ -150,6 +152,12 @@ def oracle_database(index):
     elif hasattr(index, "graph"):                      # cagra
         vecs = np.asarray(jax.device_get(index.dataset), dtype=np.float32)  # jaxlint: disable=JX01 one-time oracle corpus extraction, off the hot path
         ids = np.arange(vecs.shape[0], dtype=np.int64)
+    elif hasattr(index, "rotation"):                   # ivf_rabitq
+        # rerank is exact over the raw slab, so the oracle corpus is the
+        # raw vectors (not the 1-bit codes) — same shape as ivf_flat
+        vecs = np.asarray(jax.device_get(index.data),  # jaxlint: disable=JX01 one-time oracle corpus extraction, off the hot path
+                          dtype=np.float32).reshape(-1, index.dim)
+        ids = np.asarray(jax.device_get(index.ids), dtype=np.int64).reshape(-1)  # jaxlint: disable=JX01 one-time oracle corpus extraction, off the hot path
     elif hasattr(index, "codes"):                      # ivf_pq
         idx = index.with_recon() if index.recon is None else index
         vecs = np.asarray(jax.device_get(idx.recon),  # jaxlint: disable=JX01 one-time oracle corpus extraction, off the hot path

@@ -30,10 +30,32 @@ module owns the contract:
   bit-pinned (a true neighbor is shed only on a ≥3-way lane-bucket
   collision within one slab block).
 
-:func:`exact_gathered_dots` and :func:`int8_tier_eligible` moved here
-from ``neighbors/_packing.py`` (which re-exports them): the scoring-tier
-rule is owned by the scan core, and ``ops`` must not import from
-``neighbors``.
+Quantized-scan sub-API
+----------------------
+
+The scan core also owns the *quantized* scoring tier — the packed-code
+helpers every compressed engine shares, promoted here from private
+``ivf_pq``/``_packing`` homes so 4-bit PQ codes and 1-bit RaBitQ codes
+go through one documented seam:
+
+* :func:`int8_tier_eligible` — the ONE eligibility rule for the exact
+  single-pass bf16 MXU tier over 8-bit operands.
+* :func:`exact_gathered_dots` — the tiered gathered-dots einsum itself.
+* :func:`pack_codes4` / :func:`unpack_codes4` — 4-bit sub-quantizer
+  codes packed two-per-byte (IVF-PQ's storage tier; HBM reads halve,
+  codes unpack AFTER the gather).
+* :func:`pack_sign_bits` / :func:`unpack_sign_bits` — 1-bit sign codes
+  packed eight-per-byte (IVF-RaBitQ's storage tier; HBM reads shrink
+  8× vs int8, 32× vs f32).
+* :func:`packed_sign_dots` — the packed-binary scoring path:
+  ``⟨sign(r), q8⟩`` computed as ``2·⟨bits, q8⟩ − Σq8`` with the bits
+  unpacked post-gather and the dot taken on the int8 MXU tier
+  (popcount-as-int8-einsum; exact, see the function doc).
+  :func:`slab_dots` dispatches here via ``packed_sign=True``.
+
+:func:`exact_gathered_dots` and :func:`int8_tier_eligible` originally
+moved here from ``neighbors/_packing.py``: the scoring-tier rule is
+owned by the scan core, and ``ops`` must not import from ``neighbors``.
 """
 
 from __future__ import annotations
@@ -47,6 +69,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["int8_tier_eligible", "exact_gathered_dots", "slab_dots",
+           "pack_codes4", "unpack_codes4", "pack_sign_bits",
+           "unpack_sign_bits", "packed_sign_dots",
            "fold_topk", "fold_topk_payload", "topk_carry", "ranked_finish",
            "scan_topk", "scan_topk_fused", "list_slab_ptr", "l2_rescorer",
            "resolve_scan_kernel", "scan_kernel_sha"]
@@ -88,7 +112,69 @@ def exact_gathered_dots(subscripts: str, vecs, q):
                       precision=jax.lax.Precision.HIGHEST)
 
 
-def slab_dots(vecs, q, *, exact: bool = True):
+def pack_codes4(codes):
+    """Pack 4-bit sub-quantizer codes two-per-byte along the last axis:
+    ``[..., m] uint8 (values < 16) → [..., ⌈m/2⌉] uint8`` with the even
+    sub-quantizer in the low nibble.  Odd ``m`` pads one zero nibble —
+    :func:`unpack_codes4` takes ``m`` to strip it.  The IVF-PQ packed
+    storage tier (``ivf_pq.with_packed_codes``) stores this form; codes
+    unpack AFTER the probe gather so HBM reads move half the bytes."""
+    m = codes.shape[-1]
+    if m % 2:
+        codes = jnp.pad(codes, [(0, 0)] * (codes.ndim - 1) + [(0, 1)])
+    return (codes[..., 0::2] | (codes[..., 1::2] << 4)).astype(jnp.uint8)
+
+
+def unpack_codes4(packed, m: int):
+    """Inverse of :func:`pack_codes4`: ``[..., ⌈m/2⌉] → [..., m] uint8``
+    (low nibble first, pad nibble dropped)."""
+    lo = packed & 0xF
+    hi = packed >> 4
+    inter = jnp.stack([lo, hi], axis=-1).reshape(*packed.shape[:-1], -1)
+    return inter[..., :m].astype(jnp.uint8)
+
+
+def pack_sign_bits(x):
+    """Sign codes packed eight-per-byte along the last axis:
+    ``[..., d] → [..., ⌈d/8⌉] uint8`` with bit ``i % 8`` of byte
+    ``i // 8`` set iff ``x[..., i] >= 0`` (little bit order).  The
+    IVF-RaBitQ storage tier: one byte stores eight dimensions, so the
+    estimator scan's HBM traffic is 32× below the f32 slab's."""
+    bits = (x >= 0).astype(jnp.uint8)
+    return jnp.packbits(bits, axis=-1, bitorder="little")
+
+
+def unpack_sign_bits(packed, d: int):
+    """Inverse of :func:`pack_sign_bits`: ``[..., ⌈d/8⌉] uint8 →
+    [..., d]`` int8 in {0, 1} (pad bits dropped).  int8 output feeds the
+    int8 MXU tier of :func:`exact_gathered_dots` directly."""
+    return jnp.unpackbits(packed, axis=-1, count=d,
+                          bitorder="little").astype(jnp.int8)
+
+
+def packed_sign_dots(packed, q8):
+    """Packed-binary slab scoring: ``[nq, B, C, ⌈d/8⌉] uint8 ·
+    [nq, d] int8 → [nq, B, C] f32`` = ``⟨sign(r), q8⟩`` where
+    ``sign(r) ∈ {−1, +1}`` is the stored code and ``q8`` the int8-
+    quantized rotated query.
+
+    The popcount-as-int8-einsum formulation: with bits ``b ∈ {0, 1}``,
+    ``⟨2b − 1, q8⟩ = 2·⟨b, q8⟩ − Σq8``, so the scan unpacks the gathered
+    bytes to {0, 1} int8 **after** the gather (HBM moved only packed
+    bytes) and takes ONE bf16 MXU pass via :func:`exact_gathered_dots` —
+    exact, because every product is an integer ≤ 127 and every partial
+    sum stays < 2²⁴.  The block axis ``B`` stays a batch dimension
+    (:func:`slab_dots` pinned-shape contract)."""
+    nq, b = packed.shape[0], packed.shape[1]
+    d = q8.shape[-1]
+    bits = unpack_sign_bits(packed, d)
+    qb = jnp.broadcast_to(q8[:, None, :], (nq, b, d))
+    dots = exact_gathered_dots("qbcd,qbd->qbc", bits, qb)
+    q8sum = jnp.sum(q8.astype(jnp.float32), axis=-1)
+    return 2.0 * dots - q8sum[:, None, None]
+
+
+def slab_dots(vecs, q, *, exact: bool = True, packed_sign: bool = False):
     """Score one gathered slab: ``[nq, B, C, d] · [nq, d] → [nq, B, C]``.
 
     This is THE blocked-scan distance einsum — the single insertion point
@@ -99,7 +185,12 @@ def slab_dots(vecs, q, *, exact: bool = True):
     :func:`exact_gathered_dots`; ``exact=False`` is the IVF-PQ recon
     tier's contract — ONE bf16 MXU pass with f32 accumulation over
     already-lossy reconstructions, where HIGHEST would triple the cost for
-    precision the codes don't carry."""
+    precision the codes don't carry.  ``packed_sign=True`` is the 1-bit
+    scoring path: ``vecs`` holds packed sign bytes and ``q`` the int8
+    rotated query — dispatches to :func:`packed_sign_dots` (exact
+    ``⟨sign, q8⟩``; the estimator algebra lives with the engine)."""
+    if packed_sign:
+        return packed_sign_dots(vecs, q)
     nq, b = vecs.shape[0], vecs.shape[1]
     qb = jnp.broadcast_to(q[:, None, :], (nq, b, q.shape[-1]))
     if exact:
@@ -242,9 +333,16 @@ def l2_rescorer(data, norms, q, qn, metric: str, *, exact: bool = True,
     (``exact=True`` → :func:`exact_gathered_dots` tiering; ``exact=False``
     → the recon tier's single bf16 MXU pass).  ``clamp`` matches each
     engine's squared-L2 floor convention (IVF-Flat clamps at 0, the recon
-    tier does not)."""
+    tier does not).
+
+    ``norms=None`` is the stored-norm-free form (the RaBitQ exact-rerank
+    tier keeps no norm slab): the squared norms recompute from the
+    gathered rows and the algebra runs in ``brute_force``'s accumulation
+    order (``qn + yn − 2·dots``, clamped) — f32 addition is not
+    associative, and matching the oracle's order is what lets a
+    rerank-everything search bit-match ``brute_force.knn``."""
     flat_data = data.reshape(-1, data.shape[-1])
-    flat_norms = norms.reshape(-1)
+    flat_norms = norms.reshape(-1) if norms is not None else None
 
     def rescore(ptr, _vids):
         rows = flat_data[ptr]                     # [nq, k, d] finalists
@@ -255,7 +353,12 @@ def l2_rescorer(data, norms, q, qn, metric: str, *, exact: bool = True,
                               preferred_element_type=jnp.float32)
         if metric == "inner_product":
             return -dots
-        dist = flat_norms[ptr] - 2.0 * dots + qn[:, None]
+        if flat_norms is None:  # brute-force order, see docstring
+            rf = rows.astype(jnp.float32)
+            yn = jnp.sum(rf * rf, axis=2)
+            dist = qn[:, None] + yn - 2.0 * dots
+        else:
+            dist = flat_norms[ptr] - 2.0 * dots + qn[:, None]
         return jnp.maximum(dist, 0.0) if clamp else dist
 
     return rescore

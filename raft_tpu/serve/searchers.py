@@ -7,7 +7,8 @@ operands)`` where ``fn(queries, *operands)`` matches a direct
 only shape-varying input.  This module owns (a) the type→family mapping
 and (b) the per-family *effort knob* a degradation level shrinks:
 
-* ``ivf_flat`` / ``ivf_pq`` — ``n_probes`` (fewer lists scanned),
+* ``ivf_flat`` / ``ivf_pq`` / ``ivf_rabitq`` — ``n_probes`` (fewer
+  lists scanned),
 * ``cagra`` — ``itopk_size`` (narrower beam; iterations follow),
 * ``brute_force`` fast mode — ``cand`` (shorter shortlist); exact mode
   has no quality knob and degrades to itself.
@@ -61,20 +62,23 @@ def family_of(index) -> str:
     from ..neighbors.cagra import CagraIndex
     from ..neighbors.ivf_flat import IvfFlatIndex
     from ..neighbors.ivf_pq import IvfPqIndex
+    from ..neighbors.ivf_rabitq import IvfRabitqIndex
 
     index, _ = unwrap_tombstones(index)
     if isinstance(index, IvfFlatIndex):
         return "ivf_flat"
     if isinstance(index, IvfPqIndex):
         return "ivf_pq"
+    if isinstance(index, IvfRabitqIndex):
+        return "ivf_rabitq"
     if isinstance(index, CagraIndex):
         return "cagra"
     if isinstance(index, (jax.Array, np.ndarray)) and index.ndim == 2:
         return "brute_force"
     raise TypeError(f"no serving searcher for {type(index).__name__}; "
-                    "expected IvfFlatIndex/IvfPqIndex/CagraIndex, a "
-                    "mutation.Tombstoned view of one, or a 2-D database "
-                    "array")
+                    "expected IvfFlatIndex/IvfPqIndex/IvfRabitqIndex/"
+                    "CagraIndex, a mutation.Tombstoned view of one, or a "
+                    "2-D database array")
 
 
 def index_dim(index) -> int:
@@ -161,6 +165,15 @@ def make_searcher(index, k: int, params=None, *, effort_scale: float = 1.0,
                 p, n_probes=_scaled(min(p.n_probes, index.n_lists),
                                     effort_scale, 1))
         return ivf_pq.searcher(index, k, p, filter=filter)
+    if fam == "ivf_rabitq":
+        from ..neighbors import ivf_rabitq
+
+        p = params or ivf_rabitq.IvfRabitqSearchParams()
+        if effort_scale < 1.0:
+            p = dataclasses.replace(
+                p, n_probes=_scaled(min(p.n_probes, index.n_lists),
+                                    effort_scale, 1))
+        return ivf_rabitq.searcher(index, k, p, filter=filter)
     from ..neighbors import cagra
 
     # resolve 0 = auto itopk/width from the tuned table FIRST — scaling

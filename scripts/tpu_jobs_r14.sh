@@ -1,0 +1,118 @@
+#!/bin/bash
+# Round-14 TPU job queue: first hardware round for the RaBitQ 1-bit IVF
+# tier (raft_tpu.neighbors.ivf_rabitq — ISSUE 13).
+#   * mosaic re-stamps bench/MOSAIC_CHECK.json first, as always — the
+#     dispatch gate rejects stale kernel_sha stamps (scan_kernel_sha now
+#     also covers the packed-sign helpers in ops/blocked_scan.py, so
+#     both the fused-scan stamp and the rabitq tune table went stale
+#     this round by construction).
+#   * rabitq_smoke — the exactness oracle on hardware: rerank_k = n must
+#     be bit-identical (values AND ids) to brute force, the packed-sign
+#     int8 einsum must hit the MXU path, and a serialize v4 roundtrip
+#     must survive.  The CPU tier already proves all three; this step
+#     proves them on the device that serves.
+#   * tune_rabitq — writes the CANONICAL recall-gated
+#     (rerank_k, probe_block) table (_rabitq_tune_table.json): only a
+#     TPU run may stamp the un-suffixed table the search paths consult.
+#   * rabitq_ab — the estimator-scan vs ivf_pq recon-tier A/B
+#     (bench/RABITQ_TPU.json), hardware counterpart of the committed
+#     bench/RABITQ_CPU.json.
+# Stage order: jaxlint -> mosaic -> rabitq smoke -> tuner -> A/B ->
+# ann bench rabitq arm -> bench.py.
+# Markers stay in /tmp/tpu_jobs_r3 so steps completed by earlier rounds'
+# queues are not repeated.
+set -u
+cd /root/repo || exit 1
+LOG=/tmp/tpu_jobs_r3
+mkdir -p "$LOG"
+. "$(dirname "$0")/tpu_queue_lib.sh"
+acquire_queue_lock tpu_jobs_r14
+export RAFT_BENCH_CKPT_DIR="$LOG/bench_ckpt"
+
+echo "$(date) [r14 queue] waiting for TPU..." >> "$LOG/driver.log"
+wait_probe
+echo "$(date) TPU is back" >> "$LOG/driver.log"
+
+run_step() {  # name, timeout_s, command...   (two attempts, gated .done)
+  local name=$1 tmo=$2; shift 2
+  [ -f "$LOG/$name.done" ] && return 0
+  local attempt
+  for attempt in 1 2; do
+    echo "$(date) start $name (attempt $attempt)" >> "$LOG/driver.log"
+    timeout "$tmo" "$@" > "$LOG/$name.$attempt.log" 2>&1 9<&-
+    rc=$?
+    cp -f "$LOG/$name.$attempt.log" "$LOG/$name.log"  # latest = canonical
+    if [ "$rc" -eq 0 ]; then
+      if [ "$name" != bench ] || bench_measured "$LOG/$name.log" brute_force; then
+        touch "$LOG/$name.done"
+        echo "$(date) done $name" >> "$LOG/driver.log"
+        return 0
+      fi
+      echo "$(date) $name exited 0 with no headline measurement (wedged backend)" \
+        >> "$LOG/driver.log"
+    else
+      echo "$(date) FAILED $name (rc=$rc)" >> "$LOG/driver.log"
+    fi
+    # a killed/wedged client can poison the tunnel for the next step too:
+    # re-probe before the retry (or before handing on to the next step)
+    wait_probe
+  done
+}
+
+# jaxlint first: pure-host AST pass (ivf_rabitq's rerank resolve + the
+# health/quality oracle device_gets carry explicit JX01 waivers), zero
+# chip time
+run_step jaxlint_r14    300 python scripts/mini_lint.py --jax raft_tpu --stats-json bench/JAXLINT.json
+# mosaic BEFORE anything that dispatches Pallas: re-validates the kernels
+# on hardware and stamps the sha-scoped artifact the dispatch gate needs
+run_step mosaic         900 env RAFT_MOSAIC_REQUIRE_TPU=1 python scripts/mosaic_check.py
+# the exactness + lifecycle smoke on hardware (written to a file first:
+# run_step retries must not re-read stdin)
+cat > "$LOG/rabitq_smoke.py" <<'PY'
+import json, os, sys, tempfile
+
+sys.path.insert(0, os.getcwd())        # the queue runs this from /root/repo
+
+import numpy as np
+from raft_tpu.neighbors import brute_force, ivf_rabitq, serialize
+from raft_tpu.stats import neighborhood_recall
+
+rng = np.random.default_rng(7)
+db = rng.integers(0, 256, (6000, 64)).astype(np.float32)   # integer-valued:
+q = rng.integers(0, 256, (32, 64)).astype(np.float32)      # bitwise oracle
+index = ivf_rabitq.build(db, ivf_rabitq.IvfRabitqIndexParams(
+    n_lists=16, kmeans_n_iters=8, list_cap_ratio=2.0))
+bd, bi = brute_force.knn(q, db, 10)
+# rerank everything probed at total coverage == brute force, bit for bit
+d, i = ivf_rabitq.search(index, q, 10, ivf_rabitq.IvfRabitqSearchParams(
+    n_probes=16, rerank_k=db.shape[0]))
+np.testing.assert_array_equal(np.asarray(i), np.asarray(bi))
+np.testing.assert_array_equal(np.asarray(d), np.asarray(bd))
+# the estimator tier at a realistic rerank budget
+d8, i8 = ivf_rabitq.search(index, q, 10, ivf_rabitq.IvfRabitqSearchParams(
+    n_probes=8, rerank_k=160))
+recall = float(neighborhood_recall(np.asarray(i8), np.asarray(bi)))
+assert recall > 0.85, recall
+# serialize v4 survives the device roundtrip
+with tempfile.TemporaryDirectory() as td:
+    p = os.path.join(td, "rq")
+    serialize.save(p, index)
+    re = serialize.load(p)
+    assert serialize.verify_index(re) == []
+    d2, i2 = ivf_rabitq.search(re, q, 10, ivf_rabitq.IvfRabitqSearchParams(
+        n_probes=8, rerank_k=160))
+    np.testing.assert_array_equal(np.asarray(i2), np.asarray(i8))
+print(json.dumps({"config": "rabitq_smoke", "bitwise_vs_brute": True,
+                  "recall_p8_r160": round(recall, 4)}))
+PY
+run_step rabitq_smoke   900 python "$LOG/rabitq_smoke.py"
+# the canonical recall-gated tune table — TPU runs write the un-suffixed
+# file the search paths consult (off-TPU runs self-quarantine)
+run_step tune_rabitq   3600 python bench/tune_rabitq.py
+# estimator scan vs ivf_pq recon tier at matched recall, plus the
+# codebook-free build race -> bench/RABITQ_TPU.json
+run_step rabitq_ab     3600 python bench/rabitq_ab.py
+# the standing ann bench gains the rabitq arm's curve on hardware
+run_step ann_rabitq    1800 python bench/ann_bench.py ivf_rabitq --base synthetic:1000000x64
+run_step bench         4500 python bench.py
+echo "$(date) all steps attempted" >> "$LOG/driver.log"

@@ -42,3 +42,7 @@ def test_mutability_guide_snippets_execute():
 
 def test_observability_guide_snippets_execute():
     _run_guide("observability_guide.md", min_blocks=4)
+
+
+def test_perf_analysis_snippets_execute():
+    _run_guide("perf_analysis.md", min_blocks=1)

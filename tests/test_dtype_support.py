@@ -164,7 +164,7 @@ def test_int8_tier_dimension_guard():
     must fall back to HIGHEST — integer dot gaps of 1 would round away.
     uint8 caps at d=256, int8 at d=1024; and high-d searches still agree
     exactly with the f32 pipeline via the fallback."""
-    from raft_tpu.neighbors._packing import int8_tier_eligible
+    from raft_tpu.ops.blocked_scan import int8_tier_eligible
 
     u8 = np.zeros((2, 2), np.uint8)
     i8 = np.zeros((2, 2), np.int8)
