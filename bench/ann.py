@@ -37,6 +37,7 @@ __all__ = [
     "sweep_ivf_flat",
     "sweep_ivf_pq",
     "sweep_ivf_rabitq",
+    "sweep_ooc",
     "sweep_cagra",
     "best_at_recall",
 ]
@@ -202,6 +203,31 @@ def sweep_ivf_rabitq(index, queries, gt, k: int, probe_grid, *,
         run = lambda p=p: search_fn(index, queries, k, p)
         out.append({"n_probes": int(n_probes),
                     "rerank_k": ivf_rabitq.resolve_rerank_k(
+                        int(rerank_k), k, int(n_probes), index.list_cap),
+                    **measure_point(run, gt, nq)})
+    return out
+
+
+def sweep_ooc(index, queries, gt, k: int, probe_grid, *,
+              rerank_k: int = 0, slab_budget: int = 256 << 20,
+              overlap: bool = True, search_fn=None) -> List[dict]:
+    """(n_probes → recall, qps) curve for the out-of-core tier.  Same
+    shape as ``sweep_ivf_rabitq`` — the estimator scan is shared — but
+    every rerank crosses the host round-trip, so the QPS column prices
+    the fetch+overlap machinery, not just the device scan."""
+    from raft_tpu.neighbors import ooc
+    from raft_tpu.neighbors.ivf_rabitq import resolve_rerank_k
+
+    search_fn = search_fn or ooc.search
+    out = []
+    nq = queries.shape[0]
+    for n_probes in probe_grid:
+        p = ooc.OocSearchParams(
+            n_probes=int(n_probes), rerank_k=int(rerank_k), query_chunk=0,
+            slab_budget=int(slab_budget), overlap=bool(overlap))
+        run = lambda p=p: search_fn(index, queries, k, p)
+        out.append({"n_probes": int(n_probes),
+                    "rerank_k": resolve_rerank_k(
                         int(rerank_k), k, int(n_probes), index.list_cap),
                     **measure_point(run, gt, nq)})
     return out

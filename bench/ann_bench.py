@@ -19,7 +19,8 @@ Examples::
         --sweep 32:4,64:4,64:8
 
 Index kinds: ``brute_force`` | ``ivf_flat`` | ``ivf_pq`` | ``ivf_rabitq``
-| ``cagra``.
+| ``ooc`` | ``cagra``.  ``ooc`` keeps only compact codes on device and
+reranks through the mmap-backed host shard store (``--store-path``).
 Every result line carries the config; the last line is a summary with the
 best QPS at ``--recall-floor`` (default 0.95).
 """
@@ -110,7 +111,7 @@ def load_gt(spec, queries, base, k, metric):
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("index", choices=["brute_force", "ivf_flat", "ivf_pq",
-                                      "ivf_rabitq", "cagra"])
+                                      "ivf_rabitq", "ooc", "cagra"])
     ap.add_argument("--base", required=True, help="dataset file or synthetic:NxD")
     ap.add_argument("--query", default=None, help="query file (default: synthetic held-out / first 10k rows)")
     ap.add_argument("--gt", default=None, help="ground-truth ids file (default: computed exactly)")
@@ -123,8 +124,15 @@ def main() -> None:
                     help="4-bit packed code storage (requires --pq-bits<=4)")
     ap.add_argument("--refine", type=int, default=4, help="ivf_pq refine ratio (0 = off)")
     ap.add_argument("--rerank-k", type=int, default=0,
-                    help="ivf_rabitq exact-rerank pool (0 = tuned table / "
-                         "heuristic)")
+                    help="ivf_rabitq/ooc exact-rerank pool (0 = tuned table "
+                         "/ heuristic)")
+    ap.add_argument("--store-path", default=None,
+                    help="ooc: directory for the host shard store "
+                         "(default: a fresh temp dir)")
+    ap.add_argument("--slab-budget", type=int, default=256 << 20,
+                    help="ooc: staged-rerank device-bytes cap")
+    ap.add_argument("--no-overlap", action="store_true",
+                    help="ooc: disable prefetch overlap (A/B baseline)")
     ap.add_argument("--graph-degree", type=int, default=32)
     ap.add_argument("--sweep", default=None,
                     help="ivf: probe list '8,16,32'; cagra: 'itopk:width,...'")
@@ -194,6 +202,30 @@ def main() -> None:
         run = lambda: brute_force.knn(q, base, args.k, metric=args.metric,
                                       mode="fast")
         curve = [{"mode": "fast", **measure_point(run, gt, q.shape[0])}]
+    elif args.index == "ooc":
+        import tempfile
+
+        from raft_tpu.neighbors import ooc
+        from ann import sweep_ooc
+
+        if mesh is not None:
+            raise SystemExit("--sharded: ooc is single-device for now")
+        store = args.store_path or tempfile.mkdtemp(prefix="ooc_store_")
+        p = ooc.OocIndexParams(n_lists=n_lists, metric=args.metric)
+        # the build is always streamed — out-of-core is the point
+        index = ooc.build(np.asarray(base), p,
+                          store_path=os.path.join(store, "shards"))
+        build_s = round(time.time() - t0, 1)
+        print(json.dumps({"ooc": {
+            "resident_bytes": int(index.resident_bytes),
+            "host_bytes": int(index.host_bytes),
+            "store": store}}), flush=True)
+        probes = ([int(v) for v in args.sweep.split(",")] if args.sweep
+                  else [8, 16, 32, 64])
+        curve = sweep_ooc(index, q, gt, args.k, probes,
+                          rerank_k=args.rerank_k,
+                          slab_budget=args.slab_budget,
+                          overlap=not args.no_overlap)
     elif args.index in ("ivf_flat", "ivf_pq", "ivf_rabitq"):
         mod = __import__(f"raft_tpu.neighbors.{args.index}",
                          fromlist=[args.index])

@@ -51,7 +51,7 @@ import time
 import numpy as np
 
 from _timing import sync, timeit
-from raft_tpu.neighbors import ivf_flat, ivf_pq, ivf_rabitq
+from raft_tpu.neighbors import ivf_flat, ivf_pq, ivf_rabitq, ooc
 
 # (family, rows, dim, n_lists, chunk_rows): the 1M acceptance point runs
 # at a small chunk size — the dispatch-bound regime the fusion targets
@@ -68,10 +68,15 @@ GRID = [
     ("ivf_pq", 1_000_000, 64, 64, 65536),
     ("ivf_rabitq", 1_000_000, 64, 64, 128),
     ("ivf_rabitq", 1_000_000, 64, 64, 65536),
+    # ooc = the rabitq device stream + shard writes riding the staging
+    # thread; the A/B prices whether the disk write hides behind compute
+    ("ooc", 1_000_000, 64, 64, 128),
+    ("ooc", 1_000_000, 64, 64, 65536),
 ]
 QUICK_GRID = [("ivf_flat", 100_000, 64, 64, 128),
               ("ivf_pq", 100_000, 64, 64, 128),
-              ("ivf_rabitq", 100_000, 64, 64, 128)]
+              ("ivf_rabitq", 100_000, 64, 64, 128),
+              ("ooc", 100_000, 64, 64, 128)]
 # training is byte-identical in both engines and excluded from the
 # timings — keep it short so the bench spends its budget on the streams
 TRAIN_FRACTION, TRAIN_ITERS = 0.02, 5
@@ -85,6 +90,10 @@ def _params(family: str, n_lists: int):
             kmeans_n_iters=TRAIN_ITERS, seed=0)
     if family == "ivf_rabitq":
         return ivf_rabitq.IvfRabitqIndexParams(
+            n_lists=n_lists, kmeans_trainset_fraction=TRAIN_FRACTION,
+            kmeans_n_iters=TRAIN_ITERS, seed=0)
+    if family == "ooc":
+        return ooc.OocIndexParams(
             n_lists=n_lists, kmeans_trainset_fraction=TRAIN_FRACTION,
             kmeans_n_iters=TRAIN_ITERS, seed=0)
     return ivf_pq.IvfPqIndexParams(
@@ -118,6 +127,35 @@ def _streams(family: str, x, p, chunk_rows: int):
         pipe = lambda: ivf_rabitq._stream_pipelined(
             x, cents, rot, p, n, cap, chunk_rows, None, dt)
         return perop, pipe
+    if family == "ooc":
+        import shutil
+        import tempfile
+
+        from raft_tpu.io.shards import ShardWriter
+
+        cap = max(1, int(np.ceil(p.list_cap_ratio * n / p.n_lists)))
+        cents = ivf_flat._coarse_train_chunked(x, p, n)
+        rot = ivf_rabitq._rotation(d, p.seed)
+        sync((cents, rot))
+        dt = cents.dtype
+
+        def _with_writer(stream):
+            # fresh shard dir per rep: the stream writes the store as a
+            # side effect, so reps must not append to the same shards
+            def run():
+                root = tempfile.mkdtemp(prefix="ooc_bt_")
+                try:
+                    w = ShardWriter(os.path.join(root, "s"), d,
+                                    np.dtype("float32"), p.rows_per_shard)
+                    out = stream(x, cents, rot, p, n, cap, chunk_rows, w, dt)
+                    w.close()
+                    return out
+                finally:
+                    shutil.rmtree(root, ignore_errors=True)
+            return run
+
+        return (_with_writer(ooc._stream_perop),
+                _with_writer(ooc._stream_pipelined))
     m = p.pq_dim
     cap = max(1, int(np.ceil(p.list_cap_ratio * n / p.n_lists)))
     cents, cbs = ivf_pq._pq_train_chunked(x, p, n, m, 1 << p.pq_bits)
@@ -145,12 +183,23 @@ def main() -> None:
         perop, pipe = _streams(family, x, p, chunk_rows)
         t_perop = timeit(perop, REPS)
         t_pipe = timeit(pipe, REPS)
-        build = {"ivf_flat": ivf_flat.build_chunked,
-                 "ivf_pq": ivf_pq.build_chunked,
-                 "ivf_rabitq": ivf_rabitq.build_chunked}[family]
-        t0 = time.perf_counter()
-        sync(build(x, p, chunk_rows=chunk_rows))
-        tti = time.perf_counter() - t0
+        if family == "ooc":
+            import shutil
+            import tempfile
+
+            root = tempfile.mkdtemp(prefix="ooc_bt_")
+            t0 = time.perf_counter()
+            sync(ooc.build_chunked(x, p, store_path=os.path.join(root, "s"),
+                                   chunk_rows=chunk_rows).counts)
+            tti = time.perf_counter() - t0
+            shutil.rmtree(root, ignore_errors=True)
+        else:
+            build = {"ivf_flat": ivf_flat.build_chunked,
+                     "ivf_pq": ivf_pq.build_chunked,
+                     "ivf_rabitq": ivf_rabitq.build_chunked}[family]
+            t0 = time.perf_counter()
+            sync(build(x, p, chunk_rows=chunk_rows))
+            tti = time.perf_counter() - t0
         entry = {
             "family": family, "rows": rows, "dim": dim,
             "n_lists": n_lists, "chunk_rows": chunk_rows,

@@ -29,7 +29,8 @@ from typing import Dict, List, Tuple
 
 import numpy as np
 
-__all__ = ["HostBufferPool", "default_host_pool"]
+__all__ = ["HostBufferPool", "default_host_pool",
+           "export_host_pool_metrics"]
 
 
 class HostBufferPool:
@@ -110,6 +111,32 @@ class HostBufferPool:
             return {"hits": self._hits, "misses": self._misses,
                     "held_bytes": self._held,
                     "free_buffers": sum(map(len, self._free.values()))}
+
+
+def export_host_pool_metrics(pool: HostBufferPool = None,
+                             registry=None) -> dict:
+    """Land the pool's occupancy/hit-rate in registry gauges —
+    ``raft_host_pool_{idle_bytes,hits,misses}`` — and return the stats
+    snapshot.  A climbing ``misses`` series after warmup means some hot
+    loop is acquiring shapes the pool has never seen (a chunk-shape
+    regression); ``idle_bytes`` is the standing host-memory cost of the
+    reuse.  Called by the out-of-core search loop after each query batch
+    and by ``serve``'s ``metrics_snapshot()``."""
+    from ..obs.metrics import registry as _registry
+
+    pool = pool if pool is not None else default_host_pool()
+    reg = registry if registry is not None else _registry()
+    s = pool.stats()
+    reg.gauge("raft_host_pool_idle_bytes",
+              "bytes held idle in the host staging buffer pool").set(
+                  float(s["held_bytes"]))
+    reg.gauge("raft_host_pool_hits",
+              "host pool acquires served from the free list").set(
+                  float(s["hits"]))
+    reg.gauge("raft_host_pool_misses",
+              "host pool acquires that allocated fresh buffers").set(
+                  float(s["misses"]))
+    return s
 
 
 def default_host_pool(res=None) -> HostBufferPool:

@@ -18,6 +18,7 @@ from typing import Iterator, Optional, Tuple
 import numpy as np
 
 from . import native
+from .shards import ShardedVectorStore, ShardWriter
 
 __all__ = [
     "read_npy",
@@ -26,6 +27,8 @@ __all__ = [
     "read_ivecs",
     "vecs_shape",
     "BatchLoader",
+    "ShardWriter",
+    "ShardedVectorStore",
 ]
 
 _VECS_DTYPES = {".fvecs": (np.float32, 4), ".bvecs": (np.uint8, 1),

@@ -20,6 +20,18 @@ _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
+def _reset_for_tests(lib="unset") -> None:
+    """Clear (or force) the load-once state so tests can exercise both
+    the native and the fallback paths in one process.  ``lib=None``
+    pins the fallback (sets ``_tried`` so no build is attempted);
+    default re-arms a fresh ``_load()`` attempt."""
+    global _lib, _tried
+    if lib == "unset":
+        _lib, _tried = None, False
+    else:
+        _lib, _tried = lib, True
+
+
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _tried
     if _lib is not None or _tried:

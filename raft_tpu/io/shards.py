@@ -1,0 +1,410 @@
+"""Mmap-backed sharded vector store for the out-of-core search tier.
+
+The FusionANNS split (PAPERS.md): accelerator memory holds only compact
+codes, the full-precision rows live host-side, and only top-ranked
+candidates cross the bus.  This module is the host half — a directory of
+fixed-row ``.npy`` shards plus a JSON manifest with per-shard CRCs:
+
+    store/
+      manifest.json          {"rows", "dim", "descr", "rows_per_shard",
+                              "shards": [{"file", "rows", "crc32"}, ...]}
+      shard-00000.npy        exactly rows_per_shard rows each ...
+      shard-00042.npy        ... except the last, which may be short
+
+Global row ``i`` lives in shard ``i // rows_per_shard`` at local row
+``i % rows_per_shard`` — the store IS the id space, so the search tier's
+survivor ids address it directly with no translation table.
+
+* :class:`ShardWriter` streams a build's chunks straight to disk —
+  incremental appends into an open shard file (never buffering a whole
+  shard), so the build's peak host memory stays bounded by the chunk
+  size, not the dataset or shard size.
+* :class:`ShardedVectorStore` opens shards lazily (``np.load(mmap_mode=
+  "r")`` on first touch) and gathers arbitrary row sets grouped by
+  shard.  Dense-ish runs go through :func:`raft_tpu.io.native`'s
+  threaded pread into a pooled staging buffer
+  (:class:`~raft_tpu.core.host_memory.HostBufferPool`, fixed
+  ``fetch_batch``-row key so the hot loop allocates nothing after
+  warmup); sparse runs fall back to mmap fancy-indexing, which is also
+  the complete pure-NumPy path when the native library is absent.
+"""
+
+from __future__ import annotations
+
+import io as _io
+import json
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from . import native
+from ..core.errors import expects
+
+_MANIFEST = "manifest.json"
+_FORMAT = "raft_tpu.shards/v1"
+
+
+def _shard_name(i: int) -> str:
+    return f"shard-{i:05d}.npy"
+
+
+def _npy_header_bytes(shape: Tuple[int, ...], dtype) -> bytes:
+    """The exact v1 .npy header for (shape, dtype) — what np.save would
+    write.  Used to stream shard bytes behind a pre-written header."""
+    from numpy.lib import format as npfmt
+
+    bio = _io.BytesIO()
+    npfmt.write_array_header_1_0(bio, {
+        "descr": npfmt.dtype_to_descr(np.dtype(dtype)),
+        "fortran_order": False,
+        "shape": tuple(int(s) for s in shape),
+    })
+    return bio.getvalue()
+
+
+def _npy_data_offset(path: str) -> int:
+    """Byte offset of the data payload in a .npy file (header-aware;
+    native fast path with a pure-NumPy fallback)."""
+    if native.available():
+        hdr = native.npy_header(path)
+        if hdr is not None:
+            return int(hdr[3])
+    from numpy.lib import format as npfmt
+
+    with open(path, "rb") as f:
+        version = npfmt.read_magic(f)
+        npfmt._check_version(version)
+        npfmt._read_array_header(f, version)
+        return f.tell()
+
+
+class ShardWriter:
+    """Streaming writer: ``append()`` arbitrary row chunks, ``close()``
+    publishes the manifest.  Rows are written incrementally into the
+    open shard file (header first, payload streamed), so peak memory is
+    one append chunk — a build can stream a 100M-row dataset through
+    ``chunk_rows``-sized pieces without ever holding a shard.
+
+    Every non-final shard has exactly ``rows_per_shard`` rows.  The open
+    shard's header is written for the full shape up front; if the final
+    shard comes up short, the header is rewritten in place for the real
+    row count (same byte length for any row count — numpy pads v1
+    headers to a fixed 64-byte boundary — with a full rewrite fallback
+    if that ever fails to hold).
+    """
+
+    def __init__(self, path: str, dim: int, dtype, rows_per_shard: int):
+        expects(int(dim) > 0, "ShardWriter: dim must be positive")
+        expects(int(rows_per_shard) > 0,
+                "ShardWriter: rows_per_shard must be positive")
+        self.path = os.fspath(path)
+        self.dim = int(dim)
+        self.dtype = np.dtype(dtype)
+        self.rows_per_shard = int(rows_per_shard)
+        self.rows = 0
+        self._shards: List[dict] = []
+        self._f = None          # open shard file handle
+        self._shard_rows = 0    # rows written into the open shard
+        self._header_len = 0
+        self._closed = False
+        os.makedirs(self.path, exist_ok=True)
+
+    # -- internals ---------------------------------------------------
+
+    def _open_shard(self) -> None:
+        name = _shard_name(len(self._shards))
+        self._f = open(os.path.join(self.path, name), "wb")
+        header = _npy_header_bytes((self.rows_per_shard, self.dim),
+                                   self.dtype)
+        self._f.write(header)
+        self._header_len = len(header)
+        self._shard_rows = 0
+
+    def _close_shard(self) -> None:
+        from ..core.serialize import checksum_file
+
+        name = _shard_name(len(self._shards))
+        full = os.path.join(self.path, name)
+        if self._shard_rows != self.rows_per_shard:
+            header = _npy_header_bytes((self._shard_rows, self.dim),
+                                       self.dtype)
+            if len(header) == self._header_len:
+                self._f.seek(0)
+                self._f.write(header)
+            else:  # pragma: no cover - numpy header padding makes this rare
+                self._f.flush()
+                self._f.close()
+                data = np.fromfile(
+                    full, dtype=self.dtype, offset=self._header_len,
+                ).reshape(self._shard_rows, self.dim)
+                self._f = open(full, "wb")
+                self._f.write(header)
+                data.tofile(self._f)
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._f.close()
+        self._f = None
+        self._shards.append({
+            "file": name,
+            "rows": int(self._shard_rows),
+            "crc32": checksum_file(full),
+        })
+
+    # -- public API --------------------------------------------------
+
+    def append(self, rows) -> None:
+        """Append ``rows: [r, dim]`` (host array) to the store."""
+        expects(not self._closed, "ShardWriter: append after close")
+        rows = np.ascontiguousarray(rows, dtype=self.dtype)
+        expects(rows.ndim == 2 and rows.shape[1] == self.dim,
+                f"ShardWriter: expected [r, {self.dim}] rows, "
+                f"got {rows.shape}")
+        lo = 0
+        while lo < rows.shape[0]:
+            if self._f is None:
+                self._open_shard()
+            room = self.rows_per_shard - self._shard_rows
+            take = min(room, rows.shape[0] - lo)
+            self._f.write(rows[lo:lo + take].tobytes())
+            self._shard_rows += take
+            self.rows += take
+            lo += take
+            if self._shard_rows == self.rows_per_shard:
+                self._close_shard()
+
+    def close(self) -> "ShardedVectorStore":
+        """Finish the open shard, publish ``manifest.json`` atomically,
+        and return the opened store."""
+        from ..core.serialize import fsync_dir, write_text_atomic
+
+        expects(not self._closed, "ShardWriter: close called twice")
+        self._closed = True
+        if self._f is not None:
+            self._close_shard()
+        manifest = {
+            "format": _FORMAT,
+            "rows": int(self.rows),
+            "dim": int(self.dim),
+            "descr": np.lib.format.dtype_to_descr(self.dtype),
+            "rows_per_shard": int(self.rows_per_shard),
+            "shards": self._shards,
+        }
+        write_text_atomic(os.path.join(self.path, _MANIFEST),
+                          json.dumps(manifest, indent=1))
+        fsync_dir(self.path)
+        return ShardedVectorStore.open(self.path)
+
+
+class ShardedVectorStore:
+    """Read side: lazy per-shard mmaps + grouped gather.
+
+    ``open()`` reads only the manifest — a shard's ``np.load(mmap_mode=
+    "r")`` happens on first touch, so opening a TB-scale store is O(1)
+    and search only maps the shards its survivors actually hit.
+    """
+
+    def __init__(self, path: str, manifest: dict):
+        self.path = os.fspath(path)
+        self._m = manifest
+        n = len(manifest["shards"])
+        self._maps: List[Optional[np.memmap]] = [None] * n
+        self._offsets: List[Optional[int]] = [None] * n
+
+    # -- lifecycle ---------------------------------------------------
+
+    @classmethod
+    def open(cls, path: str) -> "ShardedVectorStore":
+        path = os.fspath(path)
+        mf = os.path.join(path, _MANIFEST)
+        expects(os.path.exists(mf),
+                f"ShardedVectorStore: no {_MANIFEST} under {path!r}")
+        with open(mf) as f:
+            manifest = json.load(f)
+        expects(manifest.get("format") == _FORMAT,
+                f"ShardedVectorStore: unrecognised manifest format "
+                f"{manifest.get('format')!r}")
+        return cls(path, manifest)
+
+    # -- shape/metadata ----------------------------------------------
+
+    @property
+    def rows(self) -> int:
+        return int(self._m["rows"])
+
+    @property
+    def dim(self) -> int:
+        return int(self._m["dim"])
+
+    @property
+    def dtype(self) -> np.dtype:
+        return np.dtype(self._m["descr"])
+
+    @property
+    def rows_per_shard(self) -> int:
+        return int(self._m["rows_per_shard"])
+
+    @property
+    def row_bytes(self) -> int:
+        return self.dim * self.dtype.itemsize
+
+    @property
+    def nbytes(self) -> int:
+        """Host-side bytes of the full-precision rows (the slab the
+        out-of-core tier keeps OFF the device)."""
+        return self.rows * self.row_bytes
+
+    @property
+    def n_shards(self) -> int:
+        return len(self._m["shards"])
+
+    def __len__(self) -> int:
+        return self.rows
+
+    # -- reads -------------------------------------------------------
+
+    def _shard_path(self, s: int) -> str:
+        return os.path.join(self.path, self._m["shards"][s]["file"])
+
+    def _shard_map(self, s: int) -> np.memmap:
+        if self._maps[s] is None:
+            self._maps[s] = np.load(self._shard_path(s), mmap_mode="r")
+        return self._maps[s]
+
+    def _shard_offset(self, s: int) -> int:
+        if self._offsets[s] is None:
+            self._offsets[s] = _npy_data_offset(self._shard_path(s))
+        return self._offsets[s]
+
+    def read_rows(self, lo: int, hi: int, out: Optional[np.ndarray] = None,
+                  *, threads: int = 8) -> np.ndarray:
+        """Dense read of global rows [lo, hi) (native pread when
+        available, mmap copy otherwise)."""
+        expects(0 <= lo <= hi <= self.rows,
+                f"read_rows: [{lo}, {hi}) out of range for {self.rows} rows")
+        if out is None:
+            out = np.empty((hi - lo, self.dim), self.dtype)
+        expects(out.shape == (hi - lo, self.dim) and out.dtype == self.dtype,
+                "read_rows: out buffer shape/dtype mismatch")
+        rps = self.rows_per_shard
+        pos = 0
+        while lo < hi:
+            s, local = lo // rps, lo % rps
+            take = min(hi - lo, rps - local)
+            dst = out[pos:pos + take]
+            done = False
+            if native.available() and dst.flags.c_contiguous:
+                off = self._shard_offset(s) + local * self.row_bytes
+                done = native.pread_dense_into(self._shard_path(s), off, dst,
+                                               threads=threads)
+            if not done:
+                np.copyto(dst, self._shard_map(s)[local:local + take])
+            lo += take
+            pos += take
+        return out
+
+    def gather(self, ids, out: Optional[np.ndarray] = None, *,
+               fetch_batch: int = 8192, threads: int = 8,
+               pool=None) -> np.ndarray:
+        """Gather rows for ``ids`` (any shape; clipped to the valid row
+        range, so sentinel ``-1`` ids read row 0 — callers mask those
+        lanes downstream) into ``out: [ids.size, dim]``.
+
+        Requests are sorted and grouped by shard; within a shard,
+        ``fetch_batch``-row windows that are dense enough (requested
+        rows ≥ span/4) are fetched with one threaded pread into a pooled
+        staging buffer, everything else fancy-indexes the shard's mmap.
+        Staging buffers are keyed by the fixed ``(fetch_batch, dim)``
+        shape, so steady-state gathers allocate nothing.
+        """
+        ids_flat = np.asarray(ids).reshape(-1)
+        expects(ids_flat.dtype.kind in "iu",
+                "gather: ids must be an integer array")
+        n = ids_flat.size
+        if out is None:
+            out = np.empty((n, self.dim), self.dtype)
+        expects(out.shape == (n, self.dim) and out.dtype == self.dtype,
+                f"gather: out must be [{n}, {self.dim}] {self.dtype}, "
+                f"got {out.shape} {out.dtype}")
+        if n == 0:
+            return out
+        clipped = np.clip(ids_flat, 0, self.rows - 1).astype(np.int64)
+        order = np.argsort(clipped, kind="stable")
+        sorted_ids = clipped[order]
+        rps = self.rows_per_shard
+        use_native = native.available()
+        if pool is None:
+            from ..core.host_memory import default_host_pool
+
+            pool = default_host_pool()
+        i = 0
+        while i < n:
+            base = sorted_ids[i]
+            s = int(base // rps)
+            shard_rows = int(self._m["shards"][s]["rows"])
+            shard_end = s * rps + shard_rows
+            # all ids in one fetch window, within this shard
+            win_end = min(base + fetch_batch, shard_end)
+            j = int(np.searchsorted(sorted_ids, win_end, side="left"))
+            window = sorted_ids[i:j] - s * rps
+            pos = order[i:j]
+            span = int(window[-1] - window[0]) + 1
+            if use_native and 4 * (j - i) >= span:
+                # dense-ish: one threaded pread of the covering span,
+                # then scatter from the pooled staging buffer
+                with pool.borrow((fetch_batch, self.dim), self.dtype) as buf:
+                    dst = buf[:span]
+                    off = (self._shard_offset(s)
+                           + int(window[0]) * self.row_bytes)
+                    if native.pread_dense_into(self._shard_path(s), off, dst,
+                                               threads=threads):
+                        out[pos] = dst[window - window[0]]
+                    else:  # native raced away; mmap fallback
+                        out[pos] = self._shard_map(s)[window]
+            else:
+                out[pos] = self._shard_map(s)[window]
+            i = j
+        return out
+
+    # -- integrity ---------------------------------------------------
+
+    def verify(self) -> List[str]:
+        """Re-checksum every shard against the manifest; returns a list
+        of problems (empty = intact)."""
+        from ..core.serialize import checksum_file
+
+        problems = []
+        total = 0
+        for s, entry in enumerate(self._m["shards"]):
+            path = self._shard_path(s)
+            if not os.path.exists(path):
+                problems.append(f"missing shard {entry['file']}")
+                continue
+            total += int(entry["rows"])
+            want = entry.get("crc32")
+            got = checksum_file(path)
+            if want is not None and got is not None and got != want:
+                problems.append(
+                    f"checksum mismatch for {entry['file']}: "
+                    f"{got} != {want}")
+        if total != self.rows:
+            problems.append(
+                f"manifest rows {self.rows} != shard total {total}")
+        return problems
+
+
+def write_store(path: str, data, *, rows_per_shard: int = 1 << 20,
+                chunk_rows: int = 1 << 16) -> ShardedVectorStore:
+    """One-shot convenience: stream ``data: [n, d]`` into a new store at
+    ``path`` in ``chunk_rows`` pieces (bounded peak memory for mmap /
+    lazy sources)."""
+    data_shape = data.shape
+    expects(len(data_shape) == 2, "write_store: data must be [n, d]")
+    w = ShardWriter(path, data_shape[1], np.asarray(data[:1]).dtype,
+                    rows_per_shard)
+    for lo in range(0, data_shape[0], chunk_rows):
+        w.append(np.asarray(data[lo:lo + chunk_rows]))
+    return w.close()
+
+
+__all__ = ["ShardWriter", "ShardedVectorStore", "write_store"]

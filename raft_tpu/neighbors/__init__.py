@@ -21,8 +21,8 @@ __all__ = ["brute_force", "knn"]
 
 
 def __getattr__(name):
-    if name in ("ivf_flat", "ivf_pq", "ivf_rabitq", "cagra", "refine",
-                "serialize", "mutation", "wal", "health"):
+    if name in ("ivf_flat", "ivf_pq", "ivf_rabitq", "ooc", "cagra",
+                "refine", "serialize", "mutation", "wal", "health"):
         import importlib
 
         mod = importlib.import_module(f"raft_tpu.neighbors.{name}")

@@ -95,6 +95,24 @@ def index_health(index) -> dict:
                 float((graph == np.arange(n)[:, None]).sum()) / graph.size
                 if graph.size else 0.0,
         }
+    elif hasattr(index, "store"):                      # ooc
+        # the memory split IS this family's structural story: the codes
+        # tier resident on device vs the raw rows host-side — plus the
+        # same occupancy/residual stats as ivf_rabitq (same device half)
+        counts = np.asarray(jax.device_get(index.counts))  # jaxlint: disable=JX01 build/swap-time health poll, never on the search path
+        rn2 = np.asarray(jax.device_get(index.res_norms))  # jaxlint: disable=JX01 build/swap-time health poll, never on the search path
+        ids = np.asarray(jax.device_get(index.ids))  # jaxlint: disable=JX01 build/swap-time health poll, never on the search path
+        live = rn2[ids >= 0]
+        out = {"family": "ooc", "rows": float(counts.sum())}
+        out.update(_occupancy_stats(counts, index.list_cap))
+        out["residual_energy_mean"] = float(live.mean()) if live.size else 0.0
+        out["residual_energy_p95"] = \
+            float(np.percentile(live, 95)) if live.size else 0.0
+        out["resident_bytes"] = float(index.resident_bytes)
+        out["host_bytes"] = float(index.host_bytes)
+        from ..neighbors.ooc import transfer_stats
+
+        out["rerank_fetch_bytes"] = float(transfer_stats()["fetch_bytes"])
     elif hasattr(index, "rotation"):                   # ivf_rabitq
         counts = np.asarray(jax.device_get(index.counts))  # jaxlint: disable=JX01 build/swap-time health poll, never on the search path
         rn2 = np.asarray(jax.device_get(index.res_norms))  # jaxlint: disable=JX01 build/swap-time health poll, never on the search path

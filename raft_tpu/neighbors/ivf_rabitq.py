@@ -423,22 +423,21 @@ def extend(index: IvfRabitqIndex, new_vectors, new_ids=None, *,
                           rn2, cs, data, out_ids, counts, index.metric)
 
 
-def _estimate_scan(q, qf, qn, cd, centroids, rotation, codes, sabs,
-                   res_norms, code_cdots, data, ids, counts, probes,
-                   k: int, rerank_k: int, metric: str, keep=None,
-                   probe_block: int = 1):
-    """Probe-blocked estimator scan + exact rerank.
+def _estimate_survivors(qf, cd, centroids, rotation, codes, sabs,
+                        res_norms, code_cdots, ids, counts, probes,
+                        rerank_k: int, metric: str, keep=None,
+                        probe_block: int = 1):
+    """Probe-blocked estimator scan: the device half shared by the
+    in-memory rerank (:func:`_estimate_scan`) and the out-of-core tier
+    (:mod:`~raft_tpu.neighbors.ooc`, which reranks against host shards).
 
     Per block: gather PACKED code bytes (the bandwidth win — ⌈d/8⌉
     bytes/row move, not 4d), score ``⟨s, q8⟩`` via the shared
     packed-binary path, apply the unbiased estimator with the gathered
     per-vector scalars, and fold an unsorted top-``rerank_k`` carrying
-    the flat-slab pointer payload.  After the scan the survivors
-    re-gather from the raw slab and re-score exactly through the
-    :func:`~raft_tpu.ops.blocked_scan.l2_rescorer` seam (stored-norm-free
-    form — the norms recompute from the gathered rows in brute-force
-    accumulation order, which is what makes ``rerank_k = n`` bit-match
-    ``brute_force.knn``); ONE ranked selection cuts to k."""
+    the flat-slab pointer payload.  Returns ``(bv, bi, bp)``: estimator
+    values, survivor source ids, and flat raw-slab pointers (meaningful
+    only when a raw slab exists — the out-of-core tier ignores it)."""
     from ._packing import blocked_probe_plan, keep_lookup
 
     nq = qf.shape[0]
@@ -494,7 +493,24 @@ def _estimate_scan(q, qf, qn, cd, centroids, rotation, codes, sabs,
     bp0 = jnp.zeros((nq, rerank_k), jnp.int32)
     (bv, bi, bp), _ = jax.lax.scan(step, (bv0, bi0, bp0),
                                    (lists_xs, pvalid))
+    return bv, bi, bp
 
+
+def _estimate_scan(q, qf, qn, cd, centroids, rotation, codes, sabs,
+                   res_norms, code_cdots, data, ids, counts, probes,
+                   k: int, rerank_k: int, metric: str, keep=None,
+                   probe_block: int = 1):
+    """Estimator scan + exact rerank.  After
+    :func:`_estimate_survivors`, the survivors re-gather from the raw
+    slab and re-score exactly through the
+    :func:`~raft_tpu.ops.blocked_scan.l2_rescorer` seam (stored-norm-free
+    form — the norms recompute from the gathered rows in brute-force
+    accumulation order, which is what makes ``rerank_k = n`` bit-match
+    ``brute_force.knn``); ONE ranked selection cuts to k."""
+    bv, bi, bp = _estimate_survivors(qf, cd, centroids, rotation, codes,
+                                     sabs, res_norms, code_cdots, ids,
+                                     counts, probes, rerank_k, metric,
+                                     keep, probe_block)
     rescore = _scan.l2_rescorer(data, None, q, qn, metric)
     dist = rescore(bp, bi)
     dist = jnp.where(jnp.isfinite(bv) & (bi >= 0), dist, jnp.inf)
@@ -598,17 +614,36 @@ def _resolve_probe_block(requested: int, n_probes: int, cap: int,
     return resolve_probe_block(0, n_probes, cap, "ivf_rabitq")
 
 
-def _resolved_static(index: IvfRabitqIndex, k: int,
-                     p: IvfRabitqSearchParams):
+def _fused_scan_fallback(requested: str) -> str:
+    """No fused Mosaic estimator kernel exists yet; an explicit
+    ``scan_kernel="fused"`` request dispatches the XLA scan.  That
+    fallback is COUNTED like every other gate decision —
+    ``raft_pallas_gate_fallback_total{kernel="rabitq_scan"}`` — instead
+    of silently rewriting the knob, so fleet dashboards see requested-
+    but-unserved fused scans."""
+    if requested != "fused":
+        return requested
+    from ..ops.pallas.gate import _count_fallback
+
+    _count_fallback(
+        "rabitq_scan",
+        "fused estimator scan not implemented; dispatching xla")
+    return "xla"
+
+
+def _resolved_static(index, k: int, p) -> Tuple[int, int, int, str]:
     """The shared search/searcher static-knob resolution: (n_probes,
-    probe_block, rerank_k, scan_kernel)."""
+    probe_block, rerank_k, scan_kernel).  Also serves the out-of-core
+    tier (:mod:`~raft_tpu.neighbors.ooc`), whose device half is this
+    family's estimator scan — ``index`` only needs ``n_lists`` and
+    ``list_cap``."""
     n_probes = int(min(p.n_probes, index.n_lists))
     probe_block = _resolve_probe_block(p.probe_block, n_probes,
                                        index.list_cap, int(k))
     rerank_k = resolve_rerank_k(p.rerank_k, int(k), n_probes,
                                 index.list_cap)
-    scan_kernel = _scan.resolve_scan_kernel(
-        p.scan_kernel, "ivf_rabitq", probe_block * index.list_cap, int(k))
+    scan_kernel = _fused_scan_fallback(_scan.resolve_scan_kernel(
+        p.scan_kernel, "ivf_rabitq", probe_block * index.list_cap, int(k)))
     return n_probes, probe_block, rerank_k, scan_kernel
 
 

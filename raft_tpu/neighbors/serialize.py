@@ -36,21 +36,28 @@ __all__ = ["save_index", "load_index", "verify_index", "index_manifest",
 # Readers accept <= _FORMAT_VERSION.  Writers stamp the LOWEST version
 # that can faithfully represent the artifact (_artifact_version), so only
 # genuinely new-format artifacts (4-bit packed codes, v2; tombstoned /
-# brute-force wrappers, v3; 1-bit RaBitQ sign codes, v4) are rejected by
-# older readers — everything else stays interchangeable.
-_FORMAT_VERSION = 4
+# brute-force wrappers, v3; 1-bit RaBitQ sign codes, v4; the out-of-core
+# manifest-directory layout, v5) are rejected by older readers —
+# everything else stays interchangeable.
+_FORMAT_VERSION = 5
 
 #: index_type names handled structurally rather than via the dataclass
-#: registry: a raw (n, d) database and the tombstoned wrapper
+#: registry: a raw (n, d) database, the tombstoned wrapper, and the
+#: out-of-core manifest directory (device bundle + shard store — its
+#: layout lives in :mod:`raft_tpu.neighbors.ooc`)
 _BRUTE_TYPE = "BruteForce"
 _TOMBSTONED_TYPE = "Tombstoned"
+_OOC_TYPE = "OocIndex"
 _KEEP_FIELD = "__keep_words"
 
 
 def _artifact_version(index) -> int:
     from .ivf_rabitq import IvfRabitqIndex
     from .mutation import Tombstoned
+    from .ooc import OocIndex
 
+    if isinstance(index, OocIndex):
+        return 5
     if isinstance(index, IvfRabitqIndex):
         return 4
     if isinstance(index, Tombstoned) or not hasattr(index, "metric"):
@@ -74,8 +81,8 @@ def _validate_meta(meta, path):
     (None for the structural types: brute-force / tombstoned)."""
     type_name = meta.get("index_type")
     registry = _index_registry()
-    if type_name not in registry and type_name != _BRUTE_TYPE \
-            and type_name != _TOMBSTONED_TYPE:
+    if type_name not in registry and type_name not in (
+            _BRUTE_TYPE, _TOMBSTONED_TYPE, _OOC_TYPE):
         raise ValueError(f"{path!r}: unknown or missing index_type {type_name!r}")
     if meta.get("format_version", 0) > _FORMAT_VERSION:
         raise ValueError(f"{path!r}: format_version {meta['format_version']} "
@@ -137,7 +144,18 @@ def save_index(path: Union[str, os.PathLike], index, *,
     fsynced, and the bundle is staged in a temp directory and published
     by one atomic rename — a reader (or :func:`verify_index`) never sees
     a torn artifact.  ``manifest`` attaches caller metadata (the WAL LSN
-    watermark for ``neighbors.wal`` snapshots)."""
+    watermark for ``neighbors.wal`` snapshots).
+
+    An out-of-core :class:`~raft_tpu.neighbors.ooc.OocIndex` routes to
+    its v5 manifest-directory layout (device bundle + shard store;
+    ``atomic`` applies to the device bundle and the meta publish — the
+    shard files copy in place first)."""
+    from .ooc import OocIndex
+    from . import ooc as _ooc
+
+    if isinstance(index, OocIndex):
+        _ooc.save(path, index, manifest=manifest, fsync=fsync)
+        return
     arrays, meta = _index_meta(index, manifest)
     save_arrays(path, arrays, metadata=meta, atomic=atomic, fsync=fsync)
 
@@ -149,8 +167,28 @@ def load_index(path: Union[str, os.PathLike], *, device: bool = True,
     (useful to inspect or re-shard before transfer).  ``verify=True``
     checks every array's CRC32 first (``core.serialize.CorruptArtifact``
     on mismatch — the recovery path quarantines instead of parsing)."""
+    if _peek_index_type(path) == _OOC_TYPE:
+        from . import ooc as _ooc
+
+        return _ooc.open(path, verify=verify)
     arrays, meta = load_arrays(path, verify=verify)
     return _index_from_parts(arrays, meta, path, device)
+
+
+def _peek_index_type(path):
+    """index_type of the artifact at ``path`` without array IO — reads
+    ``meta.json`` only.  Both layouts answer: the v5 out-of-core
+    manifest carries ``index_type`` at top level, ``save_arrays``
+    bundles nest it under ``metadata``."""
+    import json
+
+    try:
+        with open(os.path.join(os.fspath(path), "meta.json")) as f:
+            meta = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return meta.get("index_type") or \
+        (meta.get("metadata") or {}).get("index_type")
 
 
 def _index_from_parts(arrays, meta, path, device: bool):
@@ -190,7 +228,10 @@ def index_manifest(path: Union[str, os.PathLike]) -> dict:
 
     with open(os.path.join(os.fspath(path), "meta.json")) as f:
         meta = json.load(f)
-    return dict((meta.get("metadata") or {}).get("manifest") or {})
+    # save_arrays bundles nest the index meta; the v5 out-of-core layout
+    # carries its manifest at top level
+    return dict((meta.get("metadata") or {}).get("manifest")
+                or meta.get("manifest") or {})
 
 
 def verify_index(path: Union[str, os.PathLike]) -> List[str]:
@@ -203,6 +244,10 @@ def verify_index(path: Union[str, os.PathLike]) -> List[str]:
     import json
 
     path = os.fspath(path)
+    if _peek_index_type(path) == _OOC_TYPE:
+        from . import ooc as _ooc
+
+        return _ooc.verify(path)
     problems = verify_arrays(path)
     if any("meta.json" in p for p in problems):
         return problems

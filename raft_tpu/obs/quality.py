@@ -131,6 +131,8 @@ def oracle_database(index):
       so the oracle is exact over the stored representation;
     * ``ivf_rabitq`` — the raw rerank slab (rerank returns exact
       distances, so the oracle corpus is the raw vectors);
+    * ``ooc`` — the raw rows gathered from the host shard store (the
+      device half holds only codes);
     * ``cagra`` — the dataset, ids = row numbers;
     * ``mutation.Tombstoned`` — the wrapped index's corpus with deleted
       source ids removed (a tombstoned id must never count as a miss
@@ -152,6 +154,12 @@ def oracle_database(index):
     elif hasattr(index, "graph"):                      # cagra
         vecs = np.asarray(jax.device_get(index.dataset), dtype=np.float32)  # jaxlint: disable=JX01 one-time oracle corpus extraction, off the hot path
         ids = np.arange(vecs.shape[0], dtype=np.int64)
+    elif hasattr(index, "store"):                      # ooc
+        # the raw rows live host-side: gather every live slot's row from
+        # the shard store (shadow-sample scale — the oracle corpus is
+        # bounded by the sampled index, not re-read per query)
+        ids = np.asarray(jax.device_get(index.ids), dtype=np.int64).reshape(-1)  # jaxlint: disable=JX01 one-time oracle corpus extraction, off the hot path
+        vecs = np.asarray(index.store.gather(ids), dtype=np.float32)
     elif hasattr(index, "rotation"):                   # ivf_rabitq
         # rerank is exact over the raw slab, so the oracle corpus is the
         # raw vectors (not the 1-bit codes) — same shape as ivf_flat

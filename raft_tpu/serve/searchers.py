@@ -63,6 +63,7 @@ def family_of(index) -> str:
     from ..neighbors.ivf_flat import IvfFlatIndex
     from ..neighbors.ivf_pq import IvfPqIndex
     from ..neighbors.ivf_rabitq import IvfRabitqIndex
+    from ..neighbors.ooc import OocIndex
 
     index, _ = unwrap_tombstones(index)
     if isinstance(index, IvfFlatIndex):
@@ -71,14 +72,16 @@ def family_of(index) -> str:
         return "ivf_pq"
     if isinstance(index, IvfRabitqIndex):
         return "ivf_rabitq"
+    if isinstance(index, OocIndex):
+        return "ooc"
     if isinstance(index, CagraIndex):
         return "cagra"
     if isinstance(index, (jax.Array, np.ndarray)) and index.ndim == 2:
         return "brute_force"
     raise TypeError(f"no serving searcher for {type(index).__name__}; "
                     "expected IvfFlatIndex/IvfPqIndex/IvfRabitqIndex/"
-                    "CagraIndex, a mutation.Tombstoned view of one, or a "
-                    "2-D database array")
+                    "OocIndex/CagraIndex, a mutation.Tombstoned view of "
+                    "one, or a 2-D database array")
 
 
 def index_dim(index) -> int:
@@ -174,6 +177,15 @@ def make_searcher(index, k: int, params=None, *, effort_scale: float = 1.0,
                 p, n_probes=_scaled(min(p.n_probes, index.n_lists),
                                     effort_scale, 1))
         return ivf_rabitq.searcher(index, k, p, filter=filter)
+    if fam == "ooc":
+        from ..neighbors import ooc
+
+        p = params or ooc.OocSearchParams()
+        if effort_scale < 1.0:
+            p = dataclasses.replace(
+                p, n_probes=_scaled(min(p.n_probes, index.n_lists),
+                                    effort_scale, 1))
+        return ooc.searcher(index, k, p, filter=filter)
     from ..neighbors import cagra
 
     # resolve 0 = auto itopk/width from the tuned table FIRST — scaling

@@ -43,6 +43,15 @@ from .searchers import (family_of, index_dim, index_size, make_searcher,
 __all__ = ["ServerConfig", "SearchServer"]
 
 
+def _host_pool_stats() -> dict:
+    """Process staging-pool stats, exported to the global registry
+    gauges on every snapshot (``core.host_memory
+    .export_host_pool_metrics``)."""
+    from ..core.host_memory import export_host_pool_metrics
+
+    return export_host_pool_metrics()
+
+
 @dataclasses.dataclass(frozen=True)
 class ServerConfig:
     """Serving knobs (see ``docs/serving_guide.md`` for sizing).
@@ -725,7 +734,10 @@ class SearchServer:
 
     def metrics_snapshot(self) -> dict:
         """Serving metrics + live gauges + compile-cache counters (the
-        ``docs/serving_guide.md`` schema)."""
+        ``docs/serving_guide.md`` schema).  ``host_pool`` surfaces the
+        process staging-pool occupancy/hit-rate (the out-of-core tier's
+        zero-alloc contract) and refreshes the
+        ``raft_host_pool_{idle_bytes,hits,misses}`` gauges."""
         with self._cond:
             depth = len(self._pending)
             qrows = sum(r.rows for r in self._pending)
@@ -739,6 +751,7 @@ class SearchServer:
             "quality": (self.quality.stats()
                         if self.quality is not None else None),
             "slo": self.slo.stats() if self.slo is not None else None,
+            "host_pool": _host_pool_stats(),
             "server": {"family": self.family, "k": self.k,
                        "ladder": list(self.ladder),
                        "index_rows": index_size(self.index),

@@ -1,0 +1,130 @@
+#!/bin/bash
+# Round-15 TPU job queue: first hardware round for the out-of-core
+# cooperative tier (raft_tpu.neighbors.ooc + io.shards — ISSUE 14).
+#   * mosaic re-stamps bench/MOSAIC_CHECK.json first, as always — the
+#     dispatch gate rejects stale kernel_sha stamps.
+#   * ooc_smoke — the memory-split oracle on hardware: rerank_k = n must
+#     be bit-identical (values AND ids) to brute force THROUGH the host
+#     round-trip (estimator scan on device codes -> survivor ids ->
+#     shard-store gather -> staged exact rerank), the search loop must
+#     stay transfer-bounded (max_put_bytes <= one staged chunk), and a
+#     format-v5 manifest-directory roundtrip must survive.  The CPU tier
+#     already proves all three; this step proves them where HBM is real.
+#   * ooc_100m — the headline scale point: 100M x 64 f32 (25.6 GB raw,
+#     inadmissible as a flat slab) under an 8 GB device budget, with the
+#     prefetch-overlap on/off A/B -> bench/OOC_TPU.json.  On TPU the
+#     overlap column finally measures something the CPU tier cannot:
+#     the PCIe stage of chunk t+1 hiding behind chunk t's rerank.
+#   * ann_ooc — the standing ann bench gains the ooc arm's curve on
+#     hardware (10M so the sweep fits the step budget).
+# Stage order: jaxlint -> mosaic -> ooc smoke -> 100M A/B -> ann ooc ->
+# bench.py.
+# Markers stay in /tmp/tpu_jobs_r3 so steps completed by earlier rounds'
+# queues are not repeated.
+set -u
+cd /root/repo || exit 1
+LOG=/tmp/tpu_jobs_r3
+mkdir -p "$LOG"
+. "$(dirname "$0")/tpu_queue_lib.sh"
+acquire_queue_lock tpu_jobs_r15
+export RAFT_BENCH_CKPT_DIR="$LOG/bench_ckpt"
+
+echo "$(date) [r15 queue] waiting for TPU..." >> "$LOG/driver.log"
+wait_probe
+echo "$(date) TPU is back" >> "$LOG/driver.log"
+
+run_step() {  # name, timeout_s, command...   (two attempts, gated .done)
+  local name=$1 tmo=$2; shift 2
+  [ -f "$LOG/$name.done" ] && return 0
+  local attempt
+  for attempt in 1 2; do
+    echo "$(date) start $name (attempt $attempt)" >> "$LOG/driver.log"
+    timeout "$tmo" "$@" > "$LOG/$name.$attempt.log" 2>&1 9<&-
+    rc=$?
+    cp -f "$LOG/$name.$attempt.log" "$LOG/$name.log"  # latest = canonical
+    if [ "$rc" -eq 0 ]; then
+      if [ "$name" != bench ] || bench_measured "$LOG/$name.log" brute_force; then
+        touch "$LOG/$name.done"
+        echo "$(date) done $name" >> "$LOG/driver.log"
+        return 0
+      fi
+      echo "$(date) $name exited 0 with no headline measurement (wedged backend)" \
+        >> "$LOG/driver.log"
+    else
+      echo "$(date) FAILED $name (rc=$rc)" >> "$LOG/driver.log"
+    fi
+    # a killed/wedged client can poison the tunnel for the next step too:
+    # re-probe before the retry (or before handing on to the next step)
+    wait_probe
+  done
+}
+
+# jaxlint first: pure-host AST pass (the ooc search loop's device_gets
+# and pool-lifetime barriers carry explicit JX01/JX05 waivers), zero
+# chip time
+run_step jaxlint_r15    300 python scripts/mini_lint.py --jax raft_tpu --stats-json bench/JAXLINT.json
+# mosaic BEFORE anything that dispatches Pallas: re-validates the kernels
+# on hardware and stamps the sha-scoped artifact the dispatch gate needs
+run_step mosaic         900 env RAFT_MOSAIC_REQUIRE_TPU=1 python scripts/mosaic_check.py
+# the exactness + boundedness + lifecycle smoke on hardware (written to
+# a file first: run_step retries must not re-read stdin)
+cat > "$LOG/ooc_smoke.py" <<'PY'
+import json, os, sys, tempfile
+
+sys.path.insert(0, os.getcwd())        # the queue runs this from /root/repo
+
+import numpy as np
+from raft_tpu.neighbors import brute_force, ooc, serialize
+from raft_tpu.stats import neighborhood_recall
+
+# integer-valued f32 at the tier-1 suite's exact shapes/seeds: every
+# arithmetic step lands on exact floats AND the brute-force oracle is
+# tie-free for these draws (distinct distances => unique top-k to pin
+# bit-identity against; a fresh draw may tie at the k boundary)
+db = np.random.default_rng(7).integers(0, 256, (3000, 64)).astype(np.float32)
+q = np.random.default_rng(8).integers(0, 256, (16, 64)).astype(np.float32)
+td_store = tempfile.mkdtemp()
+index = ooc.build(db, ooc.OocIndexParams(
+    n_lists=16, kmeans_n_iters=8, list_cap_ratio=2.0),
+    store_path=os.path.join(td_store, "shards"))
+bd, bi = brute_force.knn(q, db, 10)
+# rerank everything at total coverage == brute force, bit for bit —
+# THROUGH the host round-trip (device estimator -> shard gather -> rerank)
+d, i = ooc.search(index, q, 10, ooc.OocSearchParams(
+    n_probes=16, rerank_k=db.shape[0]))
+np.testing.assert_array_equal(np.asarray(i), np.asarray(bi))
+np.testing.assert_array_equal(np.asarray(d), np.asarray(bd))
+# the estimator tier at a realistic rerank budget, and the transfer bound:
+# the search loop stages at most one (chunk, rerank_k, d) slab + queries
+ooc.reset_transfer_stats()
+d8, i8 = ooc.search(index, q, 10, ooc.OocSearchParams(
+    n_probes=8, rerank_k=160))
+ts = ooc.transfer_stats()
+assert ts["max_put_bytes"] <= 16 * 160 * 64 * 4 + 16 * 64 * 4, ts
+recall = float(neighborhood_recall(np.asarray(i8), np.asarray(bi)))
+assert recall > 0.85, recall
+# serialize v5 (manifest directory + sharded store) survives the roundtrip
+with tempfile.TemporaryDirectory() as td:
+    p = os.path.join(td, "oc")
+    serialize.save_index(p, index)
+    assert serialize.verify_index(p) == []
+    re = serialize.load_index(p)
+    d2, i2 = ooc.search(re, q, 10, ooc.OocSearchParams(
+        n_probes=8, rerank_k=160))
+    np.testing.assert_array_equal(np.asarray(i2), np.asarray(i8))
+print(json.dumps({"config": "ooc_smoke", "bitwise_vs_brute": True,
+                  "max_put_bytes": int(ts["max_put_bytes"]),
+                  "resident_bytes": int(index.resident_bytes),
+                  "recall_p8_r160": round(recall, 4)}))
+PY
+run_step ooc_smoke      900 python "$LOG/ooc_smoke.py"
+# the headline: 100M x 64 (25.6 GB raw — no flat slab fits) under an
+# 8 GB device budget, overlap on/off A/B -> bench/OOC_TPU.json
+run_step ooc_100m     10800 python bench/ooc_bench.py --rows 100000000 \
+  --queries 1024 --n-lists 8192 --device-budget $((8 * 1024 * 1024 * 1024)) \
+  --slab-budget $((512 * 1024 * 1024)) --sweep 16,32,64 --rerank-k 800 \
+  --train-fraction 0.002 --train-iters 5
+# the standing ann bench gains the ooc arm's curve on hardware
+run_step ann_ooc       1800 python bench/ann_bench.py ooc --base synthetic:10000000x64
+run_step bench         4500 python bench.py
+echo "$(date) all steps attempted" >> "$LOG/driver.log"

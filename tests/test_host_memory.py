@@ -77,6 +77,33 @@ class TestHostBufferPool:
         assert get_host_pool(res) is get_host_pool(res)  # lazy, then shared
         assert isinstance(default_host_pool(res), HostBufferPool)
 
+    def test_export_metrics_gauges(self):
+        from raft_tpu.core.host_memory import export_host_pool_metrics
+        from raft_tpu.obs.metrics import MetricRegistry
+
+        pool = HostBufferPool()
+        pool.release(pool.acquire((8, 4), np.float32))   # 1 miss, held
+        pool.release(pool.acquire((8, 4), np.float32))   # 1 hit
+        reg = MetricRegistry()
+        stats = export_host_pool_metrics(pool, registry=reg)
+        assert stats == pool.stats()
+
+        def gauge(name):
+            [(_, v)] = reg.gauge(name, "").samples()
+            return v
+
+        assert gauge("raft_host_pool_idle_bytes") == 8 * 4 * 4
+        assert gauge("raft_host_pool_hits") == 1.0
+        assert gauge("raft_host_pool_misses") == 1.0
+
+    def test_export_metrics_defaults_to_process_pool(self):
+        from raft_tpu.core.host_memory import export_host_pool_metrics
+        from raft_tpu.obs.metrics import MetricRegistry
+
+        reg = MetricRegistry()
+        assert export_host_pool_metrics(registry=reg) == \
+            default_host_pool().stats()
+
 
 @pytest.fixture()
 def npy_file(tmp_path, rng):

@@ -101,3 +101,75 @@ def test_read_npy_structured_dtype_falls_back(tmp_path):
     np.save(p, arr)
     got = rio.read_npy(p)
     np.testing.assert_array_equal(got, arr)
+
+
+# ---------------------------------------------------------------------------
+# load-once / fallback behavior (ISSUE 14 satellite)
+
+
+def test_pread_dense_matches_npy_bytes(tmp_path, rng):
+    """Native threaded pread of a .npy's data region returns exactly the
+    bytes np.load sees — the shard store's fast path contract."""
+    if not native.available():
+        pytest.skip("native lib unavailable")
+    arr = rng.normal(size=(257, 12)).astype(np.float32)
+    p = str(tmp_path / "a.npy")
+    np.save(p, arr)
+    _, _, _, off = native.npy_header(p)
+    out = np.empty_like(arr)
+    assert native.pread_dense_into(p, off, out, threads=4)
+    np.testing.assert_array_equal(out, np.load(p))
+
+
+def test_reset_for_tests_pins_fallback(tmp_path, rng):
+    """_reset_for_tests(None) forces every entry point onto the pure
+    NumPy path without touching the filesystem or spawning a build."""
+    arr = rng.normal(size=(40, 6)).astype(np.float32)
+    p = str(tmp_path / "a.npy")
+    np.save(p, arr)
+    try:
+        native._reset_for_tests(None)
+        assert not native.available()
+        assert native.npy_header(p) is None
+        assert native.vecs_info(p, 4) is None
+        out = np.empty_like(arr)
+        assert not native.pread_dense_into(p, 128, out)
+        # the public readers still work, through the fallback
+        np.testing.assert_array_equal(rio.read_npy(p), arr)
+    finally:
+        native._reset_for_tests()
+
+
+def test_missing_toolchain_is_quiet(monkeypatch, tmp_path):
+    """No library on disk + no toolchain: _load() returns None without
+    raising or attempting a subprocess — the package degrades silently
+    to pure NumPy (auto-build is strictly best-effort)."""
+    calls = []
+    monkeypatch.setattr(native.subprocess, "run",
+                        lambda *a, **k: calls.append(a))
+    monkeypatch.setattr(native, "_LIB_NAME", "libdoes_not_exist.so")
+    import shutil as _shutil
+    monkeypatch.setattr(_shutil, "which", lambda *_: None)
+    try:
+        native._reset_for_tests()        # re-arm the load-once latch
+        assert native._load() is None
+        assert not native.available()    # latched: no repeat attempts
+        assert calls == []               # and no build was ever spawned
+    finally:
+        native._reset_for_tests()
+
+
+def test_build_optout_env_is_quiet(monkeypatch):
+    """RAFT_TPU_BUILD_NATIVE=0 skips the auto-build even with a full
+    toolchain present."""
+    calls = []
+    monkeypatch.setattr(native.subprocess, "run",
+                        lambda *a, **k: calls.append(a))
+    monkeypatch.setattr(native, "_LIB_NAME", "libdoes_not_exist.so")
+    monkeypatch.setenv("RAFT_TPU_BUILD_NATIVE", "0")
+    try:
+        native._reset_for_tests()
+        assert native._load() is None
+        assert calls == []
+    finally:
+        native._reset_for_tests()

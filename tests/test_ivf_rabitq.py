@@ -186,6 +186,34 @@ def test_scan_kernel_arms_agree(index, queries):
         np.testing.assert_array_equal(outs[0][1], di)
 
 
+def test_fused_scan_fallback_is_counted(index, queries):
+    """Requesting the not-yet-implemented fused estimator scan must be a
+    COUNTED fallback — ``raft_pallas_gate_fallback_total{kernel=
+    "rabitq_scan"}`` increments — never a silent dispatch, and the
+    results must equal the xla arm exactly."""
+    from raft_tpu.obs.metrics import registry
+
+    c = registry().counter("raft_pallas_gate_fallback_total", "x")
+
+    def count():
+        return sum(v for labels, v in c.samples()
+                   if labels.get("kernel") == "rabitq_scan")
+
+    before = count()
+    p = IvfRabitqSearchParams(n_probes=8, rerank_k=64, scan_kernel="fused")
+    fv, fi = ivf_rabitq.search(index, queries, K, p)
+    assert count() > before
+    xp = IvfRabitqSearchParams(n_probes=8, rerank_k=64, scan_kernel="xla")
+    xv, xi = ivf_rabitq.search(index, queries, K, xp)
+    np.testing.assert_array_equal(np.asarray(fv), np.asarray(xv))
+    np.testing.assert_array_equal(np.asarray(fi), np.asarray(xi))
+    # "xla" and "auto" never count a fallback (they asked for nothing
+    # they didn't get)
+    mid = count()
+    ivf_rabitq.search(index, queries, K, xp)
+    assert count() == mid
+
+
 def test_searcher_matches_search(index, queries):
     p = IvfRabitqSearchParams(n_probes=8, rerank_k=64)
     dv, di = ivf_rabitq.search(index, queries, K, p)
@@ -391,7 +419,7 @@ def test_future_version_rejected(index, tmp_path):
     serialize.save_index(path, index)
     mpath = path / "meta.json"
     meta = json.loads(mpath.read_text())
-    meta["metadata"]["format_version"] = 5
+    meta["metadata"]["format_version"] = serialize._FORMAT_VERSION + 1
     mpath.write_text(json.dumps(meta))
     with pytest.raises(ValueError, match="newer than supported"):
         serialize.load_index(path)

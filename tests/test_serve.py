@@ -307,6 +307,9 @@ def test_degradation_activates_under_pressure(db):
     # degraded dispatches still return k valid neighbors
     snap = srv.metrics_snapshot()
     assert snap["completed"] == 4 and snap["latency_ms"]["count"] == 4
+    # the snapshot surfaces host staging-pool stats (and lands the
+    # raft_host_pool_* gauges in the global registry as a side effect)
+    assert set(snap["host_pool"]) >= {"hits", "misses", "held_bytes"}
 
 
 def test_degraded_search_returns_valid_topk(db):
