@@ -36,6 +36,16 @@ Scale knobs (CPU smoke → TPU record):
                              answered query) is timed — the
                              snapshot-cadence sizing curve.  Final JSON
                              metric: serve_recovery_s (ivf_flat only)
+  RAFT_BENCH_SERVE_FAILOVER  failover-time mode (replaces the sweep):
+                             comma list of WAL tail lengths; for each, a
+                             warm standby accumulates that many shipped-
+                             but-unapplied records, the primary goes
+                             silent, and detection (lease expiry) →
+                             promotion (drain + epoch claim + swap) →
+                             first answered query on the promoted server
+                             is timed — the ack-window sizing curve.
+                             Final JSON metric: serve_failover_s
+                             (ivf_flat only)
 """
 
 from __future__ import annotations
@@ -72,6 +82,7 @@ LADDER = tuple(int(b) for b in
                os.environ.get("RAFT_BENCH_SERVE_LADDER", "1,8,64").split(","))
 SWAPS = int(os.environ.get("RAFT_BENCH_SERVE_SWAPS", 0))
 RECOVERY = os.environ.get("RAFT_BENCH_SERVE_RECOVERY", "")
+FAILOVER = os.environ.get("RAFT_BENCH_SERVE_FAILOVER", "")
 
 # the mixed-shape request mix: point lookups dominate, small batches
 # common, bulk occasional — the traffic the bucket ladder is shaped for
@@ -257,6 +268,99 @@ def run_recovery(spec: str = RECOVERY) -> dict:
     return final
 
 
+def run_failover(spec: str = FAILOVER) -> dict:
+    """Failover timing: for each WAL tail length in ``spec``, replicate
+    a primary into a warm standby, pile that many shipped-but-unapplied
+    records in the ship queue, silence the primary, and time detection
+    (lease expiry) → promotion (drain + fenced epoch claim + generation
+    swap) → first answered query on the promoted server.  The curve
+    sizes the async ack window: a longer allowed tail is cheaper per
+    write but every queued record lands on the promotion drain path."""
+    import shutil
+    import tempfile
+
+    from raft_tpu.neighbors import mutation
+    from raft_tpu.neighbors.wal import DurableStore
+    from raft_tpu.serve import (LogShipper, QueuePair, ReplicationConfig,
+                                SearchServer, ServerConfig, StandbyReplica)
+
+    if FAMILY != "ivf_flat":
+        raise SystemExit("failover mode mutates online: ivf_flat only")
+    tails = tuple(int(p) for p in spec.split(","))
+    rng = np.random.default_rng(0)
+    db = rng.standard_normal((ROWS, DIM)).astype(np.float32)
+    index, params = _build_index(db)
+    live = mutation.delete(index, [0], id_space=2 * ROWS)
+    queries = rng.standard_normal((8, DIM)).astype(np.float32)
+    points = []
+    for tail in tails:
+        proot = tempfile.mkdtemp(prefix="raft-bench-failover-p-")
+        sroot = tempfile.mkdtemp(prefix="raft-bench-failover-s-")
+        try:
+            # async with a window past the tail: shipping never blocks,
+            # the whole tail is queued when the primary dies; refresh is
+            # deferred so the drain applies records, not swaps
+            cfg = ReplicationConfig(ack_mode="async", ship_queue=tail + 8,
+                                    lease_s=0.05, refresh_every=1 << 30)
+            a, b = QueuePair.create()
+            store = DurableStore.create(proot, live)
+            shipper = LogShipper(store, a, config=cfg)
+            replica = StandbyReplica(sroot, b, config=cfg)
+            shipper.pump()   # hello -> cold snapshot bootstrap
+            replica.poll()   # standby warm at the snapshot watermark
+            shipper.pump()
+            ssrv = SearchServer(replica.store.index, k=K, params=params,
+                                config=ServerConfig(ladder=LADDER))
+            replica.attach_server(ssrv)
+            ssrv.warmup()    # the standby was already serving reads
+            for r in range(tail):  # the shipped-but-unapplied tail
+                if r % 4 == 3:
+                    store.delete(rng.integers(0, ROWS, 2))
+                else:
+                    store.extend(
+                        rng.standard_normal((64, DIM)).astype(np.float32))
+            wal_bytes = os.path.getsize(os.path.join(proot, "wal.log"))
+            # ---- the primary dies here -------------------------------
+            replica.last_beat = replica.clock()  # last heartbeat heard
+            t0 = time.perf_counter()
+            while replica.primary_alive():
+                time.sleep(cfg.lease_s / 10)
+            t_detect = time.perf_counter()
+            replica.promote(drain_timeout_s=0.0)
+            t_promote = time.perf_counter()
+            ssrv.search(queries)  # step()-driven: no thread needed
+            t_reply = time.perf_counter()
+            point = {"config": "serve_failover", "wal_tail": tail,
+                     "wal_mib": round(wal_bytes / 2**20, 2),
+                     "detect_s": round(t_detect - t0, 3),
+                     "promote_s": round(t_promote - t_detect, 3),
+                     "first_reply_s": round(t_reply - t_promote, 3),
+                     "total_s": round(t_reply - t0, 3),
+                     "applied": replica.applied,
+                     "primary_lsn": store.wal_lsn,
+                     "epoch": replica.fence.epoch}
+            assert replica.applied == store.wal_lsn, \
+                "promotion drain lost queued records"
+            replica.store.close()
+            store.close()
+        finally:
+            shutil.rmtree(proot, ignore_errors=True)
+            shutil.rmtree(sroot, ignore_errors=True)
+        points.append(point)
+        print(json.dumps(point), flush=True)
+    final = {
+        "metric": "serve_failover_s",
+        "value": points[-1]["total_s"],
+        "unit": f"s@{tails[-1]}waltail",
+        "family": FAMILY, "rows": ROWS, "dim": DIM, "k": K,
+        "lease_s": 0.05,
+        "backend": jax.default_backend(),
+        "points": points,
+    }
+    print(json.dumps(final), flush=True)
+    return final
+
+
 def run(seconds: float = SECONDS, clients=CLIENTS) -> dict:
     """Build index, start server, sweep concurrency; returns the final
     result dict (also printed as the last JSON line)."""
@@ -318,5 +422,7 @@ def run(seconds: float = SECONDS, clients=CLIENTS) -> dict:
 if __name__ == "__main__":
     if RECOVERY:
         run_recovery()
+    elif FAILOVER:
+        run_failover()
     else:
         run()

@@ -34,6 +34,7 @@ from __future__ import annotations
 import io as _io
 import json
 import os
+import time
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -43,6 +44,18 @@ from ..core.errors import expects
 
 _MANIFEST = "manifest.json"
 _FORMAT = "raft_tpu.shards/v1"
+
+#: bounded retries for transient gather read failures (EINTR, EIO, short
+#: reads surfacing as OSError) before the error propagates
+_READ_RETRIES = 3
+
+
+def _retry_counter():
+    from ..obs.metrics import registry
+
+    return registry().counter(
+        "raft_ooc_shard_read_retries_total",
+        "transient shard read failures retried (EINTR / EIO / short read)")
 
 
 def _shard_name(i: int) -> str:
@@ -204,17 +217,27 @@ class ShardedVectorStore:
     and search only maps the shards its survivors actually hit.
     """
 
-    def __init__(self, path: str, manifest: dict):
+    def __init__(self, path: str, manifest: dict, *,
+                 verify_on_gather: bool = False):
         self.path = os.fspath(path)
         self._m = manifest
         n = len(manifest["shards"])
         self._maps: List[Optional[np.memmap]] = [None] * n
         self._offsets: List[Optional[int]] = [None] * n
+        self.verify_on_gather = bool(verify_on_gather)
+        self._verified = [False] * n
 
     # -- lifecycle ---------------------------------------------------
 
     @classmethod
-    def open(cls, path: str) -> "ShardedVectorStore":
+    def open(cls, path: str, *,
+             verify_on_gather: Optional[bool] = None) -> "ShardedVectorStore":
+        """Open a store.  ``verify_on_gather=True`` (or env
+        ``RAFT_TPU_SHARD_VERIFY=1``) CRC-checks each shard against the
+        manifest on its first read — bit-rot surfaces as a loud
+        :class:`~raft_tpu.core.serialize.CorruptArtifact` at the gather
+        that would have served it, instead of as silently wrong
+        reranks."""
         path = os.fspath(path)
         mf = os.path.join(path, _MANIFEST)
         expects(os.path.exists(mf),
@@ -224,7 +247,10 @@ class ShardedVectorStore:
         expects(manifest.get("format") == _FORMAT,
                 f"ShardedVectorStore: unrecognised manifest format "
                 f"{manifest.get('format')!r}")
-        return cls(path, manifest)
+        if verify_on_gather is None:
+            verify_on_gather = \
+                os.environ.get("RAFT_TPU_SHARD_VERIFY", "0") == "1"
+        return cls(path, manifest, verify_on_gather=bool(verify_on_gather))
 
     # -- shape/metadata ----------------------------------------------
 
@@ -276,6 +302,42 @@ class ShardedVectorStore:
             self._offsets[s] = _npy_data_offset(self._shard_path(s))
         return self._offsets[s]
 
+    def _check_shard(self, s: int) -> None:
+        """First-touch CRC verify (``verify_on_gather`` mode only)."""
+        if not self.verify_on_gather or self._verified[s]:
+            return
+        from ..core.serialize import CorruptArtifact, checksum_file
+
+        entry = self._m["shards"][s]
+        want = entry.get("crc32")
+        got = checksum_file(self._shard_path(s))
+        if want is not None and got is not None and got != want:
+            raise CorruptArtifact(
+                f"shard {entry['file']} checksum mismatch "
+                f"({got} != manifest {want}) — refusing to serve "
+                "corrupt rows")
+        self._verified[s] = True
+
+    def _read_with_retry(self, what: str, fn):
+        """Run ``fn`` with bounded retry on transient OSErrors (EINTR /
+        EIO / short reads).  Each retry counts toward the global
+        ``raft_ooc_shard_read_retries_total``; exhausted retries
+        propagate — the OOC tier degrades loudly, never silently."""
+        delay_s = 0.001
+        for attempt in range(_READ_RETRIES + 1):
+            try:
+                return fn()
+            except OSError:
+                if attempt >= _READ_RETRIES:
+                    raise
+                _retry_counter().inc()
+                from ..obs import spans as obs_spans
+
+                obs_spans.recorder().event("ooc.shard_read_retry",
+                                           what=what, attempt=attempt + 1)
+                time.sleep(delay_s)
+                delay_s *= 2
+
     def read_rows(self, lo: int, hi: int, out: Optional[np.ndarray] = None,
                   *, threads: int = 8) -> np.ndarray:
         """Dense read of global rows [lo, hi) (native pread when
@@ -292,13 +354,18 @@ class ShardedVectorStore:
             s, local = lo // rps, lo % rps
             take = min(hi - lo, rps - local)
             dst = out[pos:pos + take]
-            done = False
-            if native.available() and dst.flags.c_contiguous:
-                off = self._shard_offset(s) + local * self.row_bytes
-                done = native.pread_dense_into(self._shard_path(s), off, dst,
-                                               threads=threads)
-            if not done:
-                np.copyto(dst, self._shard_map(s)[local:local + take])
+            self._check_shard(s)
+
+            def _read(s=s, local=local, take=take, dst=dst):
+                done = False
+                if native.available() and dst.flags.c_contiguous:
+                    off = self._shard_offset(s) + local * self.row_bytes
+                    done = native.pread_dense_into(self._shard_path(s), off,
+                                                   dst, threads=threads)
+                if not done:
+                    np.copyto(dst, self._shard_map(s)[local:local + take])
+
+            self._read_with_retry(f"read_rows:shard{s}", _read)
             lo += take
             pos += take
         return out
@@ -349,20 +416,26 @@ class ShardedVectorStore:
             window = sorted_ids[i:j] - s * rps
             pos = order[i:j]
             span = int(window[-1] - window[0]) + 1
-            if use_native and 4 * (j - i) >= span:
-                # dense-ish: one threaded pread of the covering span,
-                # then scatter from the pooled staging buffer
-                with pool.borrow((fetch_batch, self.dim), self.dtype) as buf:
-                    dst = buf[:span]
-                    off = (self._shard_offset(s)
-                           + int(window[0]) * self.row_bytes)
-                    if native.pread_dense_into(self._shard_path(s), off, dst,
-                                               threads=threads):
-                        out[pos] = dst[window - window[0]]
-                    else:  # native raced away; mmap fallback
-                        out[pos] = self._shard_map(s)[window]
-            else:
-                out[pos] = self._shard_map(s)[window]
+            self._check_shard(s)
+
+            def _fetch(s=s, window=window, pos=pos, span=span):
+                if use_native and 4 * (j - i) >= span:
+                    # dense-ish: one threaded pread of the covering span,
+                    # then scatter from the pooled staging buffer
+                    with pool.borrow((fetch_batch, self.dim),
+                                     self.dtype) as buf:
+                        dst = buf[:span]
+                        off = (self._shard_offset(s)
+                               + int(window[0]) * self.row_bytes)
+                        if native.pread_dense_into(self._shard_path(s), off,
+                                                   dst, threads=threads):
+                            out[pos] = dst[window - window[0]]
+                        else:  # native raced away; mmap fallback
+                            out[pos] = self._shard_map(s)[window]
+                else:
+                    out[pos] = self._shard_map(s)[window]
+
+            self._read_with_retry(f"gather:shard{s}", _fetch)
             i = j
         return out
 

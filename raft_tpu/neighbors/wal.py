@@ -162,16 +162,67 @@ class WriteAheadLog:
         expects(op in _OPS, f"unknown WAL op {op!r} ({_OPS})")
         payload = _encode_payload(op, arrays or {}, static or {})
         with self._lock:
-            lsn = self._lsn + 1
-            self._f.write(_REC_HEADER.pack(lsn, zlib.crc32(payload),
-                                           len(payload)))
-            self._f.write(payload)
-            self._f.flush()
-            self._lsn = lsn
-            w = self.config.group_window_s
-            if w <= 0 or self._clock() - self._last_sync >= w:
-                self._do_sync()
-            return lsn
+            return self._write(self._lsn + 1, payload)
+
+    def append_record(self, rec: "WalRecord") -> int:
+        """Append an already-sequenced record (the replication apply
+        path): ``rec.lsn`` must continue the local sequence; an empty log
+        adopts it as the base (a standby bootstrapped from a snapshot at
+        watermark W starts its log at W+1)."""
+        expects(rec.op in _OPS, f"unknown WAL op {rec.op!r} ({_OPS})")
+        payload = _encode_payload(rec.op, rec.arrays, rec.static)
+        with self._lock:
+            expects(self._lsn == 0 or rec.lsn == self._lsn + 1,
+                    f"replicated lsn {rec.lsn} does not continue the "
+                    f"local wal at {self._lsn}")
+            return self._write(rec.lsn, payload)
+
+    def _write(self, lsn: int, payload: bytes) -> int:
+        self._f.write(_REC_HEADER.pack(lsn, zlib.crc32(payload),
+                                       len(payload)))
+        self._f.write(payload)
+        self._f.flush()
+        self._lsn = lsn
+        w = self.config.group_window_s
+        if w <= 0 or self._clock() - self._last_sync >= w:
+            self._do_sync()
+        return lsn
+
+    def prune(self, upto_lsn: int) -> int:
+        """Atomically rewrite the log without records ``lsn <= upto_lsn``.
+        The newest record is always retained so a later reopen can resume
+        the LSN sequence from the file alone.  Returns the number of
+        records discarded.  Callers own the safety floor —
+        :meth:`DurableStore.prune_wal` clamps to the oldest retained
+        snapshot watermark AND every registered follower's ack."""
+        with self._lock:
+            self._do_sync()
+            records, _, problems = read_wal(self.path)
+            if problems:
+                raise CorruptArtifact(
+                    f"{self.path}: refusing to prune a torn log "
+                    f"({'; '.join(problems)})")
+            upto = min(int(upto_lsn), self._lsn - 1)
+            keep = [r for r in records if r.lsn > upto]
+            dropped = len(records) - len(keep)
+            if dropped <= 0:
+                return 0
+            tmp = f"{self.path}.prune-{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(_FILE_HEADER)
+                for r in keep:
+                    payload = _encode_payload(r.op, r.arrays, r.static)
+                    f.write(_REC_HEADER.pack(r.lsn, zlib.crc32(payload),
+                                             len(payload)))
+                    f.write(payload)
+                f.flush()
+                self._fsync(f.fileno())
+            self._f.close()
+            os.replace(tmp, self.path)
+            fsync_dir(os.path.dirname(self.path) or ".")
+            self._f = open(self.path, "ab")
+            self._last_sync = self._clock()
+            return dropped
 
     def _do_sync(self) -> None:
         self._f.flush()
@@ -221,8 +272,14 @@ def read_wal(path) -> Tuple[List[WalRecord], int, List[str]]:
         if zlib.crc32(payload) != crc:
             problems.append(f"crc mismatch for lsn {lsn} at offset {off}")
             break
-        if lsn != (records[-1].lsn if records else 0) + 1:
-            problems.append(f"lsn discontinuity ({lsn}) at offset {off}")
+        if records:
+            if lsn != records[-1].lsn + 1:
+                problems.append(f"lsn discontinuity ({lsn}) at offset {off}")
+                break
+        elif lsn < 1:
+            # the first record establishes the base: a pruned log starts
+            # past 1, but lsn 0 is reserved for "empty"
+            problems.append(f"bad base lsn ({lsn}) at offset {off}")
             break
         try:
             records.append(_decode_payload(lsn, payload))
@@ -299,6 +356,12 @@ class DurableStore:
         self.index = index
         self.counters: Dict[str, int] = {}
         self.metrics = None  # ServingMetrics mirror once a server adopts us
+        self.fence = None  # serve.replication.EpochFence once replicated
+        self.on_commit: List[Any] = []  # (lsn, op, arrays, static) hooks
+        self._followers: Dict[str, int] = {}  # follower id -> acked lsn
+        # followers get their own lock: the ack pump thread must be able
+        # to record progress while a semi-sync commit holds _lock
+        self._follower_lock = threading.Lock()
         self._lock = threading.RLock()
         self.wal = WriteAheadLog(os.path.join(self.root, "wal.log"),
                                  self.config, clock=clock, _fsync=_fsync)
@@ -371,6 +434,8 @@ class DurableStore:
         with self._lock, tracing.range("wal.durable(%s)", op):
             expects(self.index is not None, "store has no index (use "
                     "DurableStore.create or DurableStore.recover)")
+            if self.fence is not None:  # a deposed primary must not write
+                self.fence.check(crash_site, count=self._count)
             # corrupt-kind faults at this site byte-flip the existing log
             # (torn-tail drill); crash-kind ones lose the op entirely
             self._fire("wal_append", self.wal.path)
@@ -380,7 +445,77 @@ class DurableStore:
             self._fire(crash_site)
             self.index = _apply(self.index, WalRecord(lsn, op, arrays,
                                                       static))
+            for hook in self.on_commit:  # replication ship, in LSN order
+                hook(lsn, op, arrays, static)
             return self.index
+
+    def apply_replicated(self, rec: WalRecord):
+        """Standby-side ingest: append the primary's record at its
+        ORIGINAL lsn, then apply it through the same :func:`_apply` fold
+        every mutation takes — a promoted standby is bit-identical
+        (values AND ids) to the primary by construction."""
+        with self._lock, tracing.range("wal.apply_replicated(%s)", rec.op):
+            expects(self.index is not None, "store has no index (use "
+                    "DurableStore.create or DurableStore.recover)")
+            self._fire("wal_append", self.wal.path)
+            self.wal.append_record(rec)
+            self._count("wal_appends")
+            self._count("wal_replicated")
+            self.index = _apply(self.index, rec)
+            for hook in self.on_commit:  # chained replication fan-out
+                hook(rec.lsn, rec.op, rec.arrays, rec.static)
+            return self.index
+
+    # -- follower watermarks (WAL retention floor) --------------------
+
+    def register_follower(self, follower_id: str, ack_lsn: int = 0) -> None:
+        """Track a replication follower's ack watermark:
+        :meth:`prune_wal` never discards records past the slowest
+        registered follower, so a catching-up standby is never
+        stranded."""
+        with self._follower_lock:
+            self._followers[str(follower_id)] = max(
+                int(ack_lsn), self._followers.get(str(follower_id), 0))
+
+    def follower_acked(self, follower_id: str, lsn: int) -> None:
+        """Advance a follower's durable watermark (monotonic)."""
+        self.register_follower(follower_id, lsn)
+
+    def drop_follower(self, follower_id: str) -> None:
+        """Forget a decommissioned follower so it stops pinning the WAL."""
+        with self._follower_lock:
+            self._followers.pop(str(follower_id), None)
+
+    def followers(self) -> Dict[str, int]:
+        """Registered follower ack watermarks (snapshot copy)."""
+        with self._follower_lock:
+            return dict(self._followers)
+
+    def follower_floor(self) -> Optional[int]:
+        """Min ack watermark over registered followers (None if none)."""
+        with self._follower_lock:
+            return min(self._followers.values()) if self._followers else None
+
+    def prune_wal(self) -> int:
+        """Discard WAL records that are covered by BOTH the oldest
+        retained snapshot (the local replay base) and every registered
+        follower's ack watermark.  Returns the number of records
+        dropped."""
+        with self._lock:
+            snaps = self.snapshots()
+            expects(bool(snaps), "prune_wal needs a published snapshot "
+                    "(the replay base)")
+            floor = int(index_manifest(
+                os.path.join(self.snap_dir, snaps[0])).get("wal_lsn", 0))
+            follower = self.follower_floor()
+            if follower is not None:
+                floor = min(floor, follower)
+            dropped = self.wal.prune(floor)
+            if dropped:
+                self._count("wal_pruned", dropped)
+                obs_spans.recorder().event("wal.prune", dropped=dropped,
+                                           floor=floor)
+            return dropped
 
     # -- snapshots ----------------------------------------------------
 
@@ -394,6 +529,8 @@ class DurableStore:
         ``WalConfig.retain_snapshots`` published snapshots."""
         with self._lock, tracing.range("wal.snapshot"):
             expects(self.index is not None, "store has no index")
+            if self.fence is not None:  # deposed primaries publish nothing
+                self.fence.check("snapshot", count=self._count)
             self.wal.sync()  # the manifest must never lead the disk
             lsn = self.wal.lsn
             final = os.path.join(self.snap_dir, f"{_SNAP_PREFIX}{lsn:020d}")
@@ -465,6 +602,10 @@ class DurableStore:
         self.index = None
         self.counters = {}
         self.metrics = None
+        self.fence = None
+        self.on_commit = []
+        self._followers = {}
+        self._follower_lock = threading.Lock()
         self._lock = threading.RLock()
 
         # 1) snapshots: quarantine strays (crashed-mid-publish temp dirs),
@@ -507,6 +648,11 @@ class DurableStore:
                     f.flush()
                     os.fsync(f.fileno())
             tail = [r for r in records if r.lsn > watermark]
+            if tail and tail[0].lsn != watermark + 1:
+                raise CorruptArtifact(
+                    f"{wal_path}: WAL pruned past the snapshot watermark "
+                    f"(first tail lsn {tail[0].lsn}, watermark {watermark})"
+                    " — replay would silently skip mutations")
             self.index = replay(self.index, tail)
             self._count("wal_replayed", len(tail))
         self.wal = WriteAheadLog(wal_path, self.config, clock=clock,

@@ -55,9 +55,13 @@ from .bucketing import DEFAULT_LADDER, bucket_for, normalize_ladder
 from .cache import ExecutableCache
 from .compaction import CompactionPolicy, CompactionScheduler
 from .faults import (CRASH_EXIT_CODE, TRANSIENT_FAULTS, DeviceOOM, FaultError,
-                     FaultInjector, SwapFailed, WedgedDevice)
+                     FaultInjector, FencedError, Partitioned, SwapFailed,
+                     WedgedDevice)
 from .metrics import ServingMetrics, UnknownCounter
 from .registry import Generation, IndexRegistry
+from .replication import (EpochFence, EpochToken, LogShipper, QueuePair,
+                          ReplicationConfig, SocketListener, SocketTransport,
+                          StandbyReplica)
 from .searchers import family_of, make_searcher, unwrap_tombstones
 from .server import SearchServer, ServerConfig
 from ..obs.watchdog import StallWatchdog
@@ -83,8 +87,18 @@ __all__ = [
     "WedgedDevice",
     "DeviceOOM",
     "SwapFailed",
+    "Partitioned",
+    "FencedError",
     "TRANSIENT_FAULTS",
     "FaultInjector",
+    "EpochFence",
+    "EpochToken",
+    "LogShipper",
+    "QueuePair",
+    "ReplicationConfig",
+    "SocketListener",
+    "SocketTransport",
+    "StandbyReplica",
     "Generation",
     "IndexRegistry",
     "DEFAULT_LADDER",

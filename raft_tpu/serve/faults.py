@@ -21,7 +21,13 @@ of a bench anecdote:
   prove ``DurableStore.recover`` restores a bit-identical index;
 * ``corrupt`` — flips one byte of the file/directory the site passed to
   :meth:`FaultInjector.fire` (torn-write / bit-rot injection for the
-  checksum + quarantine paths).
+  checksum + quarantine paths);
+* ``partition`` — raises :class:`Partitioned` at the replication ship
+  sites (``ship_send``/``ship_ack``): the message is *dropped*, not
+  delivered late, exactly like a network partition.  Healing is
+  deterministic: once the armed count is consumed the link carries
+  traffic again, and the replication layer's resync (hello + watermark
+  catch-up) repairs the gap.
 
 A :class:`FaultInjector` is armed per *site* (serve dispatch:
 ``"execute"``, ``"swap"``, ``"extend"``; durability, fired by
@@ -47,11 +53,25 @@ from typing import Optional
 from .admission import ServeError
 
 __all__ = ["FaultError", "WedgedDevice", "DeviceOOM", "SwapFailed",
-           "TRANSIENT_FAULTS", "FaultInjector", "CRASH_EXIT_CODE"]
+           "Partitioned", "FencedError", "TRANSIENT_FAULTS",
+           "FaultInjector", "CRASH_EXIT_CODE"]
 
 
 class FaultError(ServeError):
     """An injected (or injected-equivalent) runtime fault."""
+
+
+class Partitioned(FaultError):
+    """The replication link dropped this message (injected network
+    partition at a ``ship_send``/``ship_ack`` site).  The sender counts
+    the drop and moves on — delivery is repaired by watermark resync,
+    never by blocking."""
+
+
+class FencedError(ServeError):
+    """A deposed primary tried to write after a newer epoch was observed
+    (``EpochFence.check``).  Terminal for that node's write path: the
+    split-brain guard — recover by rejoining as a standby."""
 
 
 class WedgedDevice(FaultError):
@@ -75,9 +95,10 @@ class SwapFailed(ServeError):
 #: deadline).
 TRANSIENT_FAULTS = (WedgedDevice, DeviceOOM)
 
-_KINDS = ("wedge", "slow", "oom", "fail", "crash", "corrupt")
+_KINDS = ("wedge", "slow", "oom", "fail", "crash", "corrupt", "partition")
 _SITES = ("execute", "swap", "extend",
-          "wal_append", "snapshot", "rename", "compact")
+          "wal_append", "snapshot", "rename", "compact",
+          "ship_send", "ship_ack")
 
 #: the crash exit code (SIGKILL convention) the subprocess driver asserts
 CRASH_EXIT_CODE = 137
@@ -201,6 +222,8 @@ class FaultInjector:
             raise WedgedDevice(f"injected wedge at {site!r}")
         if kind == "oom":
             raise DeviceOOM(f"injected OOM at {site!r}")
+        if kind == "partition":
+            raise Partitioned(f"injected partition at {site!r}")
         raise FaultError(f"injected failure at {site!r}")
 
     def fired_count(self, site: str, kind: Optional[str] = None) -> int:

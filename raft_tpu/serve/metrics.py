@@ -68,6 +68,10 @@ COUNTER_SPECS = (
     ("stalls", "wedged dispatches detected by the stall watchdog"),
     ("wal_appends", "durable mutations logged (neighbors.wal)"),
     ("wal_replayed", "WAL records replayed during recovery"),
+    ("wal_replicated", "shipped WAL records applied by a standby"),
+    ("wal_pruned", "WAL records discarded by prune (snapshot + follower "
+     "ack floor)"),
+    ("fenced_writes", "writes rejected on a deposed primary (epoch fence)"),
     ("snapshots", "crash-consistent snapshots published"),
     ("quarantined_files", "corrupt artifacts renamed aside"),
     ("recoveries", "DurableStore.recover completions"),

@@ -1,0 +1,1049 @@
+"""Replicated durability — WAL shipping, warm standby, fenced failover
+(ISSUE 15 tentpole).
+
+PR 7 made one node crash-recoverable: every mutation is a WAL record,
+``DurableStore._apply`` is the ONLY mutation path (live and replay), so
+recovery is a deterministic fold.  This module turns that contract into
+high availability: a :class:`LogShipper` on the primary streams the same
+CRC-framed records to a :class:`StandbyReplica`, which appends them to
+its own WAL *at the primary's LSNs* and applies them through the same
+fold — a promoted standby is bit-identical (values AND ids) to the
+primary by construction, not by comparison.
+
+Pieces:
+
+* **Transport seam** — messages are ``kind + arrays + static`` reusing
+  the WAL payload codec, framed ``magic | version | crc32 | len``.
+  :meth:`QueuePair.create` wires two in-process endpoints (deterministic
+  tests, single-host benches); :class:`SocketListener` /
+  :class:`SocketTransport` carry the same frames over localhost TCP
+  (the subprocess SIGKILL drill).  Both tolerate drops: delivery is
+  repaired by watermark resync, never by blocking retry.
+* **Ack modes** — ``async`` ships and moves on (loss window bounded by
+  ``ReplicationConfig.ship_queue`` unacked records: the publisher blocks
+  once the standby falls further behind); ``semi_sync`` extends the
+  group-commit contract across the wire — the mutator's return waits for
+  the standby ack (or degrades to async for that write after
+  ``ack_timeout_s``, counted).
+* **Catch-up** — a follower says hello with its ack watermark; the
+  primary replies with the WAL tail past it, or a snapshot bootstrap
+  (newest published checkpoint, shipped file-by-file) when the follower
+  is cold or pruned-past.  ``DurableStore.prune_wal`` never discards
+  records a registered follower has not acked, so catch-up from any
+  live watermark always finds its tail.
+* **Failure detection** — heartbeats carry ``(epoch, lsn, primary
+  clock)``; :meth:`StandbyReplica.primary_alive` is a lease check over
+  them, and lag is exported as ``raft_replication_lag_lsn`` /
+  ``raft_replication_lag_seconds`` (primary-clock arithmetic: no
+  cross-host clock comparison).
+* **Fenced promotion** — epochs are ``(epoch, node_id)`` tokens ordered
+  lexicographically.  :meth:`StandbyReplica.promote` drains the ship
+  queue, claims ``max_seen + 1``, persists it, announces it; a deposed
+  primary observing the higher token has every subsequent append / swap
+  / snapshot rejected (:class:`.faults.FencedError`, counted as
+  ``fenced_writes``).  The double-promotion race converges because the
+  token order is total: exactly one claimant stays unfenced.
+
+Chaos drills: the ``ship_send`` / ``ship_ack`` fault sites accept the
+``partition`` kind (message dropped, deterministic heal when the armed
+count is consumed), and every loss path above is exercised in
+``tests/test_replication.py`` — including a subprocess SIGKILL failover
+in the style of ``tests/_durability_driver.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import queue
+import shutil
+import socket
+import struct
+import threading
+import time
+import zlib
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..core.errors import expects
+from ..core.serialize import CorruptArtifact, fsync_dir, write_text_atomic
+from ..neighbors.serialize import index_manifest
+from ..neighbors.wal import (DurableStore, WalRecord, _decode_payload,
+                             _encode_payload, read_wal)
+from ..obs import metrics as obs_metrics
+from ..obs import spans as obs_spans
+from .faults import FencedError, Partitioned
+
+__all__ = ["Message", "encode_message", "decode_message",
+           "QueuePair", "SocketListener", "SocketTransport",
+           "EpochToken", "EpochFence", "ReplicationConfig",
+           "LogShipper", "StandbyReplica"]
+
+_MSG_MAGIC = b"RTRM"
+_MSG_VERSION = 1
+_MSG_HEADER = struct.Struct("<4sBIQ")  # magic, version, crc32, payload_len
+_EPOCH_FILE = "epoch"
+
+
+# -- message framing ----------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Message:
+    """One decoded replication message: ``kind`` routes it, ``arrays``
+    carry bulk payloads (WAL record operands, snapshot file bytes),
+    ``static`` the JSON-able metadata."""
+
+    kind: str
+    arrays: Dict[str, np.ndarray]
+    static: Dict[str, Any]
+
+
+def encode_message(kind: str, arrays: Optional[Dict[str, Any]] = None,
+                   **static) -> bytes:
+    """Frame one message: the WAL payload codec (json head + npy
+    streams) under a ``magic | version | crc32 | length`` header — the
+    same torn/corrupt self-detection the on-disk log has, on the wire."""
+    payload = _encode_payload(kind, arrays or {}, static)
+    return _MSG_HEADER.pack(_MSG_MAGIC, _MSG_VERSION, zlib.crc32(payload),
+                            len(payload)) + payload
+
+
+def decode_message(blob: bytes) -> Message:
+    """Parse + verify one framed message (raises
+    :class:`core.serialize.CorruptArtifact` on any mismatch — a mangled
+    frame must never half-apply)."""
+    if len(blob) < _MSG_HEADER.size:
+        raise CorruptArtifact(
+            f"short replication frame ({len(blob)} bytes)")
+    magic, version, crc, plen = _MSG_HEADER.unpack_from(blob)
+    if magic != _MSG_MAGIC or version != _MSG_VERSION:
+        raise CorruptArtifact(
+            f"bad replication frame header ({magic!r} v{version})")
+    payload = blob[_MSG_HEADER.size:_MSG_HEADER.size + plen]
+    if len(payload) != plen or zlib.crc32(payload) != crc:
+        raise CorruptArtifact("replication frame length/crc mismatch")
+    rec = _decode_payload(0, payload)
+    return Message(rec.op, rec.arrays, rec.static)
+
+
+# -- transports ---------------------------------------------------------
+
+
+class QueueTransport:
+    """One endpoint of an in-process :meth:`QueuePair.create` link.
+    Bytes round-trip through the full encode/decode (CRC verified), so
+    in-process tests exercise the same framing the socket path does."""
+
+    def __init__(self, inbox: "queue.Queue", outbox: "queue.Queue") -> None:
+        self._inbox = inbox
+        self._outbox = outbox
+        self.closed = False
+
+    def send(self, blob: bytes) -> None:
+        self._outbox.put(bytes(blob))
+
+    def recv(self, timeout: float = 0.0) -> Optional[Message]:
+        try:
+            if timeout and timeout > 0:
+                blob = self._inbox.get(timeout=timeout)
+            else:
+                blob = self._inbox.get_nowait()
+        except queue.Empty:
+            return None
+        return decode_message(blob)
+
+    def pending(self) -> int:
+        """Messages delivered but not yet received — the in-flight ship
+        queue the async-mode loss bound is measured against."""
+        return self._inbox.qsize()
+
+    def close(self) -> None:
+        self.closed = True
+
+
+class QueuePair:
+    """Factory for a bidirectional in-process link."""
+
+    @staticmethod
+    def create(maxsize: int = 0):
+        """``(a, b)`` endpoints: whatever ``a`` sends, ``b`` receives,
+        and vice versa, in order."""
+        ab: "queue.Queue" = queue.Queue(maxsize)
+        ba: "queue.Queue" = queue.Queue(maxsize)
+        return QueueTransport(ba, ab), QueueTransport(ab, ba)
+
+
+class SocketTransport:
+    """Localhost TCP carrier for the same frames; partial reads are
+    buffered so a frame split across segments reassembles, and a dead
+    peer turns into ``closed=True`` + ``recv() -> None`` (never an
+    unhandled exception on the serving path)."""
+
+    def __init__(self, sock: socket.socket) -> None:
+        self._sock = sock
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        self._buf = b""
+        self._send_lock = threading.Lock()
+        self.closed = False
+
+    @classmethod
+    def connect(cls, host: str, port: int,
+                timeout: float = 30.0) -> "SocketTransport":
+        return cls(socket.create_connection((host, port), timeout=timeout))
+
+    def send(self, blob: bytes) -> None:
+        with self._send_lock:
+            self._sock.sendall(blob)
+
+    def _parse(self) -> Optional[Message]:
+        if len(self._buf) < _MSG_HEADER.size:
+            return None
+        plen = _MSG_HEADER.unpack_from(self._buf)[3]
+        total = _MSG_HEADER.size + plen
+        if len(self._buf) < total:
+            return None
+        blob = self._buf[:total]
+        self._buf = self._buf[total:]
+        return decode_message(blob)
+
+    def recv(self, timeout: float = 0.0) -> Optional[Message]:
+        msg = self._parse()
+        if msg is not None:
+            return msg
+        deadline = time.monotonic() + max(float(timeout), 0.0)
+        while not self.closed:
+            remaining = deadline - time.monotonic()
+            if remaining < 0:
+                return None
+            self._sock.settimeout(max(remaining, 0.001))
+            try:
+                chunk = self._sock.recv(1 << 20)
+            except socket.timeout:
+                continue
+            except OSError:
+                self.closed = True
+                return None
+            if not chunk:  # orderly peer close
+                self.closed = True
+                return None
+            self._buf += chunk
+            msg = self._parse()
+            if msg is not None:
+                return msg
+        return None
+
+    def pending(self) -> int:
+        """Complete frames buffered locally (in-flight kernel bytes are
+        invisible — the socket loss bound is asserted via watermarks)."""
+        n, off = 0, 0
+        while len(self._buf) - off >= _MSG_HEADER.size:
+            plen = _MSG_HEADER.unpack_from(self._buf, off)[3]
+            if len(self._buf) - off < _MSG_HEADER.size + plen:
+                break
+            off += _MSG_HEADER.size + plen
+            n += 1
+        return n
+
+    def close(self) -> None:
+        self.closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class SocketListener:
+    """Accept side of the socket transport (the standby in the failover
+    drill listens; the primary child process connects)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(4)
+        self.host, self.port = self._sock.getsockname()[:2]
+
+    def accept(self, timeout: float = 30.0) -> SocketTransport:
+        self._sock.settimeout(timeout)
+        conn, _ = self._sock.accept()
+        return SocketTransport(conn)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+# -- epochs + fencing ---------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class EpochToken:
+    """Totally-ordered promotion claim: epochs compare first, node ids
+    break ties — two standbys racing ``promote()`` at the same epoch
+    resolve deterministically, no coordinator needed."""
+
+    epoch: int
+    node_id: str
+
+
+class EpochFence:
+    """Monotonic split-brain guard threaded through ``DurableStore``
+    (append/snapshot) and ``SearchServer.swap_index``.
+
+    ``writer=True`` marks the node as claiming primaryship: its writes
+    raise :class:`.faults.FencedError` the moment a higher token has
+    been observed.  A standby keeps ``writer=False`` (it must checkpoint
+    and refresh its serving generation while *correctly* observing the
+    primary's higher epoch) until :meth:`advance` promotes it.  The
+    current and max-seen tokens persist to ``<root>/epoch`` so a
+    restarted deposed primary stays deposed."""
+
+    def __init__(self, node_id: str, epoch: int = 0, *,
+                 root: Optional[str] = None, writer: bool = False) -> None:
+        self.node_id = str(node_id)
+        self.epoch = int(epoch)
+        self.writer = bool(writer)
+        self.root = os.fspath(root) if root is not None else None
+        self._lock = threading.Lock()
+        self._max_seen = EpochToken(self.epoch, self.node_id)
+
+    @property
+    def token(self) -> EpochToken:
+        return EpochToken(self.epoch, self.node_id)
+
+    @property
+    def max_seen(self) -> EpochToken:
+        with self._lock:
+            return self._max_seen
+
+    @property
+    def fenced(self) -> bool:
+        """True when a strictly newer claim than ours has been observed."""
+        with self._lock:
+            return self._max_seen > EpochToken(self.epoch, self.node_id)
+
+    def observe(self, epoch: int, node_id: str = "") -> bool:
+        """Fold a remote token into ``max_seen``; returns the (possibly
+        new) fenced state."""
+        tok = EpochToken(int(epoch), str(node_id))
+        with self._lock:
+            newly = tok > self._max_seen
+            if newly:
+                self._max_seen = tok
+        if newly and self.root is not None:
+            self._persist()
+        return self.fenced
+
+    def advance(self) -> int:
+        """Claim the next epoch (promotion): strictly greater than every
+        claim this node has observed, persisted before it is announced."""
+        with self._lock:
+            self.epoch = self._max_seen.epoch + 1
+            self.writer = True
+            self._max_seen = EpochToken(self.epoch, self.node_id)
+        if self.root is not None:
+            self._persist()
+        return self.epoch
+
+    def check(self, site: str, count=None) -> None:
+        """Raise :class:`.faults.FencedError` when a deposed writer tries
+        to write at ``site``; ``count`` (a counter callable) records the
+        rejection as ``fenced_writes``."""
+        if self.writer and self.fenced:
+            if count is not None:
+                count("fenced_writes")
+            obs_spans.recorder().event("replication.fenced_write",
+                                       site=site, node=self.node_id,
+                                       epoch=self.epoch)
+            raise FencedError(
+                f"node {self.node_id!r} epoch {self.epoch} deposed by "
+                f"{self.max_seen} — write at {site!r} rejected")
+
+    def _persist(self) -> None:
+        seen = self.max_seen
+        write_text_atomic(
+            os.path.join(self.root, _EPOCH_FILE),
+            f"{self.epoch} {self.node_id}\n{seen.epoch} {seen.node_id}\n")
+
+    @classmethod
+    def load(cls, root, node_id: str, *, writer: bool = False) -> "EpochFence":
+        """Restore a fence from ``<root>/epoch`` (fresh roots start at
+        epoch 0)."""
+        self = cls(node_id, root=root, writer=writer)
+        path = os.path.join(self.root, _EPOCH_FILE)
+        if os.path.exists(path):
+            with open(path) as f:
+                lines = f.read().splitlines()
+            own = lines[0].split()
+            if own[0].lstrip("-").isdigit() and own[1:] == [self.node_id]:
+                self.epoch = int(own[0])
+            seen = lines[1].split(None, 1) if len(lines) > 1 else own
+            self._max_seen = max(EpochToken(self.epoch, self.node_id),
+                                 EpochToken(int(seen[0]),
+                                            seen[1] if len(seen) > 1 else ""))
+        return self
+
+
+# -- configuration ------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicationConfig:
+    """Replication policy knobs.
+
+    ``ack_mode``: ``"async"`` (ship and continue; loss window bounded by
+    ``ship_queue`` unacked records) or ``"semi_sync"`` (the mutator's
+    return waits for the standby ack, extending the group-commit
+    durability contract across the wire; a wait past ``ack_timeout_s``
+    degrades that one write to async and counts
+    ``raft_replication_ack_timeouts_total``).  ``heartbeat_interval_s`` /
+    ``lease_s``: failure-detection cadence — the standby declares the
+    primary dead after ``lease_s`` without traffic.  ``refresh_every``:
+    the standby's bounded-staleness serve refresh — swap the serving
+    generation every N applied records."""
+
+    ack_mode: str = "async"
+    ack_timeout_s: float = 5.0
+    ship_queue: int = 256
+    heartbeat_interval_s: float = 1.0
+    lease_s: float = 3.0
+    refresh_every: int = 1
+
+
+_ACK_MODES = ("async", "semi_sync")
+
+
+# -- primary: the log shipper ------------------------------------------
+
+
+class LogShipper:
+    """Streams a primary :class:`DurableStore`'s WAL to followers.
+
+    Hooks ``store.on_commit`` so every committed mutation ships in LSN
+    order (under the store lock — ordering is structural, not
+    best-effort).  Incoming traffic (``hello`` / ``ack`` / ``fence``) is
+    consumed by :meth:`pump`, either manually (deterministic tests) or
+    from :meth:`start`'s background thread.  Follower watermarks live on
+    the store itself (``register_follower`` / ``follower_acked``) so
+    ``DurableStore.prune_wal`` sees them without knowing this class."""
+
+    def __init__(self, store: DurableStore, transport, *,
+                 config: Optional[ReplicationConfig] = None,
+                 node_id: str = "primary", registry=None, faults=None,
+                 clock=time.monotonic) -> None:
+        self.store = store
+        self.transport = transport
+        self.config = config or ReplicationConfig()
+        expects(self.config.ack_mode in _ACK_MODES,
+                f"unknown ack_mode {self.config.ack_mode!r} ({_ACK_MODES})")
+        self.node_id = str(node_id)
+        self.clock = clock
+        self.faults = faults if faults is not None \
+            else getattr(store, "faults", None)
+        reg = registry if registry is not None else obs_metrics.registry()
+        self.metrics = reg
+        self._acks = reg.counter("raft_replication_acks_total",
+                                 "standby acks processed by the primary")
+        self._shipped = reg.counter("raft_replication_records_total",
+                                    "WAL records shipped to followers")
+        self._drops = reg.counter(
+            "raft_replication_drops_total",
+            "replication messages dropped (partition / link down)")
+        self._ack_timeouts = reg.counter(
+            "raft_replication_ack_timeouts_total",
+            "semi-sync ack waits that timed out (that write degraded "
+            "to async)")
+        self._resyncs = reg.counter(
+            "raft_replication_resyncs_total",
+            "follower catch-up streams served (hello / gap resync)")
+        self._lag_lsn = reg.gauge(
+            "raft_replication_lag_lsn",
+            "primary WAL lsn minus the slowest follower's acked lsn")
+        self._lag_s = reg.gauge(
+            "raft_replication_lag_seconds",
+            "seconds since the slowest follower's last ack "
+            "(primary clock)")
+        fence = getattr(store, "fence", None)
+        self.fence = fence if fence is not None \
+            else EpochFence.load(store.root, self.node_id, writer=True)
+        self.fence.writer = True
+        store.fence = self.fence
+        if self.fence.epoch == 0 and not self.fence.fenced:
+            # epoch 0 is the unclaimed era (every fresh node holds it):
+            # a primary's authority must outrank all unclaimed tokens,
+            # so shipping starts by claiming epoch 1
+            self.fence.advance()
+        self._ack_t: Dict[str, float] = {}  # follower -> clock at last ack
+        self._cond = threading.Condition()
+        self._last_beat = float("-inf")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        store.on_commit.append(self._on_commit)
+
+    # -- outbound ------------------------------------------------------
+
+    def _send(self, blob: bytes, *, what: str) -> bool:
+        if self.faults is not None:
+            try:
+                self.faults.fire("ship_send")
+            except Partitioned:
+                self._drops.inc()
+                obs_spans.recorder().event("replication.drop",
+                                           site="ship_send", what=what)
+                return False
+        try:
+            self.transport.send(blob)
+        except OSError as exc:
+            self._drops.inc()
+            obs_spans.recorder().event("replication.drop", site="ship_send",
+                                       what=what, error=type(exc).__name__)
+            return False
+        return True
+
+    def _record_blob(self, lsn: int, op: str, arrays, static) -> bytes:
+        return encode_message("record", arrays, lsn=int(lsn), op=str(op),
+                              record_static=static, node=self.node_id,
+                              epoch=self.fence.epoch, t=self.clock())
+
+    def _on_commit(self, lsn: int, op: str, arrays, static) -> None:
+        # runs under the store lock: records enter the wire in LSN order
+        if self._send(self._record_blob(lsn, op, arrays, static),
+                      what=f"record:{lsn}"):
+            self._shipped.inc()
+        floor = self.store.follower_floor()
+        if floor is None:
+            return  # nobody registered yet — hello catch-up will resync
+        if self.config.ack_mode == "semi_sync":
+            self._await_floor(lsn, self.config.ack_timeout_s)
+        else:
+            window = max(0, int(self.config.ship_queue))
+            if lsn - floor > window:  # async backpressure = loss bound
+                self._await_floor(lsn - window, self.config.ack_timeout_s)
+
+    def _await_floor(self, target: int, timeout_s: float) -> bool:
+        deadline = self.clock() + timeout_s
+        while True:
+            floor = self.store.follower_floor()
+            if floor is None or floor >= target:
+                return True
+            remaining = deadline - self.clock()
+            if remaining <= 0:
+                self._ack_timeouts.inc()
+                obs_spans.recorder().event("replication.ack_timeout",
+                                           target=target, floor=floor)
+                return False
+            if self._thread is not None and self._thread.is_alive():
+                with self._cond:  # the pump thread notifies on acks
+                    self._cond.wait(min(remaining, 0.05))
+            else:
+                self.pump(min(remaining, 0.05))
+
+    def beat(self, force: bool = False) -> None:
+        """Heartbeat: ``(epoch, lsn, primary clock)`` — the standby's
+        lease and lag-seconds source.  Rate-limited to
+        ``heartbeat_interval_s`` unless forced."""
+        now = self.clock()
+        if not force and now - self._last_beat \
+                < self.config.heartbeat_interval_s:
+            return
+        self._last_beat = now
+        self._send(encode_message("heartbeat", None, node=self.node_id,
+                                  lsn=self.store.wal_lsn,
+                                  epoch=self.fence.epoch, t=now),
+                   what="heartbeat")
+        self._update_lag()
+
+    # -- inbound -------------------------------------------------------
+
+    def pump(self, timeout: float = 0.0) -> int:
+        """Process pending follower traffic; returns messages handled."""
+        n = 0
+        t = timeout
+        while True:
+            msg = self.transport.recv(t)
+            if msg is None:
+                return n
+            self._handle(msg)
+            n += 1
+            t = 0.0
+
+    def _handle(self, msg: Message) -> None:
+        s = msg.static
+        if "epoch" in s and self.fence.observe(s.get("epoch", 0),
+                                               s.get("node", "")):
+            obs_spans.recorder().event("replication.deposed",
+                                       node=self.node_id,
+                                       by=str(self.fence.max_seen))
+        if msg.kind == "hello":
+            fid = str(s["node"])
+            ack = int(s["ack_lsn"])
+            self.store.register_follower(fid, ack)
+            self._ack_t[fid] = self.clock()
+            self._catch_up(fid, ack, cold=bool(s.get("cold")))
+        elif msg.kind == "ack":
+            fid = str(s["node"])
+            self.store.follower_acked(fid, int(s["lsn"]))
+            self._acks.inc()
+            self._ack_t[fid] = self.clock()
+            self._update_lag()
+            with self._cond:
+                self._cond.notify_all()
+        # fence messages need no handler beyond the observe above
+
+    def _update_lag(self) -> None:
+        floor = self.store.follower_floor()
+        if floor is None:
+            return
+        lag = max(0, self.store.wal_lsn - floor)
+        self._lag_lsn.set(float(lag))
+        if lag == 0 or not self._ack_t:
+            self._lag_s.set(0.0)
+        else:
+            self._lag_s.set(max(0.0,
+                                self.clock() - min(self._ack_t.values())))
+
+    # -- catch-up ------------------------------------------------------
+
+    def _catch_up(self, fid: str, from_lsn: int, cold: bool) -> None:
+        rec = obs_spans.recorder()
+        with rec.span("replication.catch_up", follower=fid,
+                      from_lsn=from_lsn, cold=cold):
+            self._resyncs.inc()
+            records: List[WalRecord] = []
+            if os.path.exists(self.store.wal.path):
+                self.store.wal.sync()
+                records, _, _ = read_wal(self.store.wal.path)
+            base = records[0].lsn - 1 if records else self.store.wal_lsn
+            if cold or from_lsn < base:
+                # the tail alone cannot reach the follower's watermark:
+                # bootstrap from the newest published snapshot
+                watermark = self._ship_snapshot()
+                from_lsn = max(from_lsn, watermark)
+            for r in records:
+                if r.lsn > from_lsn:
+                    if not self._send(self._record_blob(r.lsn, r.op,
+                                                        r.arrays, r.static),
+                                      what=f"catchup:{r.lsn}"):
+                        break  # partitioned: the follower will re-hello
+            self.beat(force=True)
+
+    def _ship_snapshot(self) -> int:
+        snaps = self.store.snapshots()
+        if not snaps:
+            self.store.snapshot()
+            snaps = self.store.snapshots()
+        name = snaps[-1]
+        path = os.path.join(self.store.snap_dir, name)
+        watermark = int(index_manifest(path).get("wal_lsn", 0))
+        files: List[str] = []
+        for walk_root, _, fns in os.walk(path):
+            files += [os.path.relpath(os.path.join(walk_root, fn), path)
+                      for fn in fns]
+        files.sort()
+        arrays = {f"f{i:04d}": np.fromfile(os.path.join(path, rel),
+                                           dtype=np.uint8)
+                  for i, rel in enumerate(files)}
+        self._send(encode_message("snapshot", arrays, name=name,
+                                  watermark=watermark, files=files,
+                                  node=self.node_id,
+                                  epoch=self.fence.epoch, t=self.clock()),
+                   what=f"snapshot:{name}")
+        return watermark
+
+    # -- lifecycle -----------------------------------------------------
+
+    @property
+    def followers(self) -> Dict[str, int]:
+        return self.store.followers()
+
+    def start(self) -> "LogShipper":
+        """Background pump: follower traffic + heartbeats."""
+        expects(self._thread is None, "shipper already started")
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run,
+                                        name="raft-log-shipper", daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.pump(0.05)
+                self.beat()
+            except Exception as exc:  # noqa: BLE001 — keep shipping
+                obs_spans.recorder().event("replication.pump_error",
+                                           error=type(exc).__name__)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+# -- standby ------------------------------------------------------------
+
+
+class StandbyReplica:
+    """Warm follower: applies shipped records through the store's own
+    ``_apply`` fold (bit-identity by construction), acks watermarks,
+    serves bounded-staleness reads via an attached server, and promotes
+    with a fenced epoch claim.
+
+    A fresh root bootstraps cold (hello → snapshot ship → records); a
+    root with prior state recovers locally and catches up from its
+    watermark.  Drive it manually with :meth:`poll` (deterministic
+    tests) or :meth:`start` a background thread."""
+
+    def __init__(self, root, transport, *,
+                 config: Optional[ReplicationConfig] = None,
+                 node_id: str = "standby", registry=None, faults=None,
+                 clock=time.monotonic, store_config=None,
+                 hello: bool = True) -> None:
+        self.root = os.fspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self.transport = transport
+        self.config = config or ReplicationConfig()
+        self.node_id = str(node_id)
+        self.clock = clock
+        self.faults = faults
+        self.store_config = store_config
+        reg = registry if registry is not None else obs_metrics.registry()
+        self.metrics = reg
+        self._applied_c = reg.counter("raft_replication_applied_total",
+                                      "records applied by this standby")
+        self._gaps = reg.counter(
+            "raft_replication_gaps_total",
+            "out-of-sequence ship messages (each triggers a resync)")
+        self._stale = reg.counter(
+            "raft_replication_stale_epoch_total",
+            "messages from a deposed epoch, dropped")
+        self._drops = reg.counter(
+            "raft_replication_drops_total",
+            "replication messages dropped (partition / link down)")
+        self._failovers = reg.counter("raft_failovers_total",
+                                      "standby promotions completed")
+        self._lag_lsn = reg.gauge(
+            "raft_replication_lag_lsn",
+            "primary WAL lsn minus the slowest follower's acked lsn")
+        self._lag_s = reg.gauge(
+            "raft_replication_lag_seconds",
+            "seconds since the slowest follower's last ack "
+            "(primary clock)")
+        self.fence = EpochFence.load(self.root, self.node_id, writer=False)
+        self.store: Optional[DurableStore] = None
+        if self._has_local_state():
+            self.store = DurableStore.recover(self.root,
+                                              config=store_config,
+                                              faults=faults, clock=clock)
+            self.store.fence = self.fence
+        self.applied = self._local_watermark()
+        self.applied_t: Optional[float] = None  # primary clock, last apply
+        self.primary_lsn = self.applied
+        self.primary_t: Optional[float] = None  # primary clock, last beat
+        self.last_beat: Optional[float] = None  # local clock, last traffic
+        self.promoted = False
+        self.server = None
+        self._refreshed = -1
+        self._resync_at = -1
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if hello:
+            self.hello()
+
+    # -- local state ---------------------------------------------------
+
+    def _has_local_state(self) -> bool:
+        snap_dir = os.path.join(self.root, "snapshots")
+        if os.path.isdir(snap_dir) and any(
+                n.startswith("snap-") and "." not in n
+                for n in os.listdir(snap_dir)):
+            return True
+        wal_path = os.path.join(self.root, "wal.log")
+        return os.path.exists(wal_path) and os.path.getsize(wal_path) > 0
+
+    def _local_watermark(self) -> int:
+        if self.store is None:
+            return 0
+        w = self.store.wal_lsn
+        snaps = self.store.snapshots()
+        if snaps:
+            manifest = index_manifest(
+                os.path.join(self.store.snap_dir, snaps[-1]))
+            w = max(w, int(manifest.get("wal_lsn", 0)))
+        return w
+
+    # -- outbound ------------------------------------------------------
+
+    def _send(self, blob: bytes, *, what: str) -> bool:
+        if self.faults is not None:
+            try:
+                self.faults.fire("ship_ack")
+            except Partitioned:
+                self._drops.inc()
+                obs_spans.recorder().event("replication.drop",
+                                           site="ship_ack", what=what)
+                return False
+        try:
+            self.transport.send(blob)
+        except OSError as exc:
+            self._drops.inc()
+            obs_spans.recorder().event("replication.drop", site="ship_ack",
+                                       what=what, error=type(exc).__name__)
+            return False
+        return True
+
+    def hello(self) -> None:
+        """(Re)introduce this follower: the primary registers the ack
+        watermark and streams the missing tail (or a snapshot)."""
+        self._send(encode_message("hello", None, node=self.node_id,
+                                  ack_lsn=self.applied,
+                                  cold=self.store is None,
+                                  epoch=self.fence.epoch, t=self.clock()),
+                   what="hello")
+
+    def _ack(self, lsn: int) -> None:
+        self._send(encode_message("ack", None, node=self.node_id,
+                                  lsn=int(lsn), epoch=self.fence.epoch,
+                                  t=self.clock()),
+                   what=f"ack:{lsn}")
+
+    def _request_resync(self) -> None:
+        if self._resync_at == self.applied:
+            return  # one outstanding request per watermark
+        self._resync_at = self.applied
+        self.hello()
+
+    # -- inbound -------------------------------------------------------
+
+    def poll(self, timeout: float = 0.0, max_messages: int = 0) -> int:
+        """Apply pending ship traffic; returns messages handled."""
+        n = 0
+        t = timeout
+        while True:
+            msg = self.transport.recv(t)
+            if msg is None:
+                return n
+            self._handle(msg)
+            n += 1
+            if max_messages and n >= max_messages:
+                return n
+            t = 0.0
+
+    def _handle(self, msg: Message) -> None:
+        s = msg.static
+        sender = EpochToken(int(s.get("epoch", 0)), str(s.get("node", "")))
+        if msg.kind in ("record", "snapshot", "heartbeat") \
+                and sender < self.fence.token:
+            # a deposed primary's leftovers: never apply (split brain)
+            self._stale.inc()
+            obs_spans.recorder().event("replication.stale_epoch",
+                                       kind=msg.kind, sender=str(sender))
+            return
+        if self.fence.observe(sender.epoch, sender.node_id) \
+                and self.promoted:
+            self.promoted = False  # outranked after our own promotion
+            obs_spans.recorder().event("replication.deposed",
+                                       node=self.node_id,
+                                       by=str(self.fence.max_seen))
+        if msg.kind == "record":
+            self.last_beat = self.clock()
+            self._on_record(msg)
+        elif msg.kind == "snapshot":
+            self.last_beat = self.clock()
+            self._bootstrap(msg)
+        elif msg.kind == "heartbeat":
+            self.last_beat = self.clock()
+            self.primary_lsn = max(self.primary_lsn, int(s.get("lsn", 0)))
+            self.primary_t = float(s.get("t", 0.0))
+            if self.primary_lsn > self.applied:
+                self._request_resync()  # records were dropped on the wire
+            self._update_lag()
+        elif msg.kind == "fence":
+            pass  # the observe above did the work
+
+    def _on_record(self, msg: Message) -> None:
+        s = msg.static
+        lsn = int(s["lsn"])
+        self.primary_lsn = max(self.primary_lsn, lsn)
+        self.primary_t = float(s.get("t", 0.0))
+        if self.store is None:
+            self._request_resync()  # cold: need the snapshot first
+            return
+        if lsn <= self.applied:
+            self._ack(self.applied)  # duplicate from a resync: re-ack
+        elif lsn == self.applied + 1:
+            rec = WalRecord(lsn, str(s["op"]), msg.arrays,
+                            dict(s.get("record_static") or {}))
+            self.store.apply_replicated(rec)
+            self.applied = lsn
+            self.applied_t = float(s.get("t", 0.0))
+            self._applied_c.inc()
+            self._ack(lsn)
+            self._refresh_server()
+        else:
+            self._gaps.inc()
+            obs_spans.recorder().event("replication.gap", got=lsn,
+                                       want=self.applied + 1)
+            self._request_resync()
+        self._update_lag()
+
+    def _bootstrap(self, msg: Message) -> None:
+        s = msg.static
+        watermark = int(s["watermark"])
+        if self.store is not None and self.applied >= watermark:
+            return  # already warm past this checkpoint
+        rec = obs_spans.recorder()
+        with rec.span("replication.bootstrap", watermark=watermark):
+            snap_dir = os.path.join(self.root, "snapshots")
+            os.makedirs(snap_dir, exist_ok=True)
+            tmp = os.path.join(snap_dir, f"bootstrap-{os.getpid()}.tmp")
+            shutil.rmtree(tmp, ignore_errors=True)
+            for i, rel in enumerate(s["files"]):
+                data = msg.arrays[f"f{i:04d}"]
+                fp = os.path.join(tmp, rel)
+                os.makedirs(os.path.dirname(fp), exist_ok=True)
+                with open(fp, "wb") as f:
+                    f.write(data.tobytes())
+                    f.flush()
+                    os.fsync(f.fileno())
+            final = os.path.join(snap_dir, str(s["name"]))
+            shutil.rmtree(final, ignore_errors=True)
+            os.rename(tmp, final)
+            fsync_dir(snap_dir)
+            # any local WAL predates this checkpoint (the primary only
+            # bootstraps when the tail cannot reach our watermark):
+            # subsumed, and its lsn base could not continue the stream
+            wal_path = os.path.join(self.root, "wal.log")
+            if self.store is not None:
+                self.store.close()
+            if os.path.exists(wal_path):
+                os.unlink(wal_path)
+            self.store = DurableStore.recover(self.root,
+                                              config=self.store_config,
+                                              faults=self.faults,
+                                              clock=self.clock)
+            self.store.fence = self.fence
+            self.applied = self._local_watermark()
+            self._ack(self.applied)
+            if self.server is not None:
+                self.server.adopt_store(self.store)
+            self._refresh_server(force=True)
+            self._update_lag()
+
+    def _update_lag(self) -> None:
+        lag = max(0, self.primary_lsn - self.applied)
+        self._lag_lsn.set(float(lag))
+        if lag == 0 or self.applied_t is None or self.primary_t is None:
+            self._lag_s.set(0.0)
+        else:
+            # primary-clock arithmetic on both operands: no cross-host
+            # clock comparison sneaks in
+            self._lag_s.set(max(0.0, self.primary_t - self.applied_t))
+
+    def lag(self) -> Dict[str, float]:
+        """Current replication lag: ``{"lsn": ..., "seconds": ...}``."""
+        self._update_lag()
+        return {"lsn": float(self._lag_lsn.value()),
+                "seconds": float(self._lag_s.value())}
+
+    def primary_alive(self, now: Optional[float] = None) -> bool:
+        """Lease check: any primary traffic within ``lease_s``?"""
+        if self.last_beat is None:
+            return False
+        now = self.clock() if now is None else now
+        return (now - self.last_beat) <= self.config.lease_s
+
+    # -- serving -------------------------------------------------------
+
+    def attach_server(self, server) -> "StandbyReplica":
+        """Serve bounded-staleness reads from this standby: the server's
+        generation is swapped every ``refresh_every`` applied records,
+        and the server inherits the fence (its ``swap_index`` stays
+        permitted — ``writer=False`` — until promotion flips it)."""
+        self.server = server
+        server.fence = self.fence
+        server.replication = self
+        if self.store is not None:
+            server.adopt_store(self.store)
+            self._refresh_server(force=True)
+        return self
+
+    def _refresh_server(self, force: bool = False) -> None:
+        if self.server is None or self.store is None:
+            return
+        every = max(1, int(self.config.refresh_every))
+        if not force and self.applied - self._refreshed < every:
+            return
+        if self.store.index is not self.server.index:
+            self.server.swap_index(self.store.index)
+        self._refreshed = self.applied
+
+    @property
+    def is_serving(self) -> bool:
+        """Promoted and not outranked — the double-promotion drill
+        asserts exactly one node in the fleet reports True."""
+        return self.promoted and not self.fence.fenced
+
+    # -- promotion -----------------------------------------------------
+
+    def promote(self, drain_timeout_s: float = 0.25) -> DurableStore:
+        """Fail over: drain the ship queue (every delivered record
+        applies before the epoch turns), claim + persist + announce the
+        next epoch, fsync the WAL, and swap the freshest generation into
+        the attached server.  Returns the now-primary store."""
+        rec = obs_spans.recorder()
+        span = rec.start("replication.promote", node=self.node_id,
+                         applied=self.applied)
+        # 1) drain: keep pulling until the link stays silent
+        while self.poll(drain_timeout_s):
+            pass
+        expects(self.store is not None,
+                "nothing to promote — this standby never bootstrapped")
+        # 2) claim the next epoch (persisted before it is announced)
+        epoch = self.fence.advance()
+        self.promoted = True
+        self._failovers.inc()
+        self.store.wal.sync()
+        # 3) announce: the deposed primary (if alive) and racing peers
+        #    fence themselves on this token
+        self._send(encode_message("fence", None, node=self.node_id,
+                                  epoch=epoch, t=self.clock()),
+                   what="fence")
+        # 4) serve
+        self._refresh_server(force=True)
+        self._update_lag()
+        rec.finish(span, epoch=epoch, lsn=self.applied)
+        return self.store
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "StandbyReplica":
+        """Background poll loop (apply + ack + lease bookkeeping)."""
+        expects(self._thread is None, "standby already started")
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run,
+                                        name="raft-standby", daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll(0.05)
+            except Exception as exc:  # noqa: BLE001 — keep following
+                obs_spans.recorder().event("replication.poll_error",
+                                           error=type(exc).__name__)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -139,6 +139,8 @@ class SearchServer:
         # exactly; distinct replicas pass distinct seeds to decorrelate
         self._retry_rng = random.Random(self.seed ^ 0x9E3779B9)
         self.durable_store = None  # neighbors.wal.DurableStore, if adopted
+        self.fence = None          # replication.EpochFence, if replicated
+        self.replication = None    # LogShipper / StandbyReplica, if any
         # flight recorder: the process-wide ring unless the caller wires
         # its own (tests; multi-server hosts separating evidence)
         self.recorder = recorder if recorder is not None \
@@ -199,6 +201,57 @@ class SearchServer:
         srv = cls(store.index, k, params, **kw)
         srv.adopt_store(store)
         return srv
+
+    def attach_replication(self, role: str, transport=None, *,
+                           config=None, node_id=None, root=None,
+                           store_config=None, replica=None):
+        """Wire WAL replication (:mod:`.replication`) onto this server.
+
+        ``role="primary"`` hooks a :class:`.replication.LogShipper` onto
+        the adopted :class:`~raft_tpu.neighbors.wal.DurableStore`: every
+        committed mutation ships to the follower on ``transport``, acks
+        flow back (``pump()`` manually or ``start()`` the background
+        thread on the returned shipper), and the store + this server
+        inherit the epoch fence — once deposed, appends and swaps raise
+        :class:`.faults.FencedError`.
+
+        ``role="standby"`` attaches a
+        :class:`.replication.StandbyReplica` (pass ``root=`` for its
+        durable directory, or a pre-built ``replica=``): applied records
+        refresh the serving generation at the configured staleness
+        bound, and ``replica.promote()`` fails this server over to
+        primary.  Replication gauges/counters land on this server's
+        metric registry, so ``prometheus_text()`` scrapes
+        ``raft_replication_lag_{lsn,seconds}``,
+        ``raft_replication_acks_total`` and ``raft_failovers_total``."""
+        from .replication import LogShipper, StandbyReplica
+
+        expects(role in ("primary", "standby"),
+                f"role must be 'primary' or 'standby', got {role!r}")
+        if role == "primary":
+            expects(self.durable_store is not None,
+                    "replicating a primary needs an adopted DurableStore "
+                    "(SearchServer.recover or adopt_store first)")
+            expects(transport is not None, "primary role needs a transport")
+            shipper = LogShipper(self.durable_store, transport,
+                                 config=config,
+                                 node_id=node_id or "primary",
+                                 registry=self.metrics.registry,
+                                 faults=self.faults, clock=self.clock)
+            self.fence = shipper.fence
+            self.replication = shipper
+            return shipper
+        if replica is None:
+            expects(transport is not None and root is not None,
+                    "standby role needs transport= + root= "
+                    "(or a pre-built replica=)")
+            replica = StandbyReplica(root, transport, config=config,
+                                     node_id=node_id or "standby",
+                                     registry=self.metrics.registry,
+                                     faults=self.faults, clock=self.clock,
+                                     store_config=store_config)
+        replica.attach_server(self)
+        return replica
 
     @property
     def generation(self) -> int:
@@ -549,6 +602,8 @@ class SearchServer:
         complete against them — the swap never interrupts a dispatch."""
         expects((new_index is None) != (build is None),
                 "pass exactly one of new_index= or build=")
+        if self.fence is not None:  # a deposed primary must not swap
+            self.fence.check("swap", count=self.metrics.count)
         old = self._registry.current
         retry = self.config.retry
         try:

@@ -1,0 +1,142 @@
+#!/bin/bash
+# Round-16 TPU job queue: first hardware round for replicated
+# durability (raft_tpu.serve.replication — ISSUE 15).
+#   * mosaic re-stamps bench/MOSAIC_CHECK.json first, as always — the
+#     dispatch gate rejects stale kernel_sha stamps.
+#   * replication_smoke — the ship/promote contract where the serving
+#     backend is real: a semi-sync primary replicates extend/delete/
+#     compact into a warm standby over the in-process pair, the standby
+#     promotes, and the promoted index must be bit-identical (values
+#     AND ids) to the primary THROUGH the device round-trip (the folds
+#     run on the hardware backend, not the CPU tier the suite pins).
+#     The deposed primary's append and swap must raise FencedError, and
+#     lag + failover counters must land in prometheus_text().
+#   * failover_bench — detection -> promotion -> first-good-reply vs
+#     WAL tail length at serving scale (200k x 96) on hardware; the
+#     CPU curve is committed as bench/FAILOVER_CPU.json, this one is
+#     harvested from the step log into FAILOVER_TPU.json next round.
+# Stage order: jaxlint -> mosaic -> replication smoke -> failover bench
+# -> bench.py.
+# Markers stay in /tmp/tpu_jobs_r3 so steps completed by earlier rounds'
+# queues are not repeated.
+set -u
+cd /root/repo || exit 1
+LOG=/tmp/tpu_jobs_r3
+mkdir -p "$LOG"
+. "$(dirname "$0")/tpu_queue_lib.sh"
+acquire_queue_lock tpu_jobs_r16
+export RAFT_BENCH_CKPT_DIR="$LOG/bench_ckpt"
+
+echo "$(date) [r16 queue] waiting for TPU..." >> "$LOG/driver.log"
+wait_probe
+echo "$(date) TPU is back" >> "$LOG/driver.log"
+
+run_step() {  # name, timeout_s, command...   (two attempts, gated .done)
+  local name=$1 tmo=$2; shift 2
+  [ -f "$LOG/$name.done" ] && return 0
+  local attempt
+  for attempt in 1 2; do
+    echo "$(date) start $name (attempt $attempt)" >> "$LOG/driver.log"
+    timeout "$tmo" "$@" > "$LOG/$name.$attempt.log" 2>&1 9<&-
+    rc=$?
+    cp -f "$LOG/$name.$attempt.log" "$LOG/$name.log"  # latest = canonical
+    if [ "$rc" -eq 0 ]; then
+      if [ "$name" != bench ] || bench_measured "$LOG/$name.log" brute_force; then
+        touch "$LOG/$name.done"
+        echo "$(date) done $name" >> "$LOG/driver.log"
+        return 0
+      fi
+      echo "$(date) $name exited 0 with no headline measurement (wedged backend)" \
+        >> "$LOG/driver.log"
+    else
+      echo "$(date) FAILED $name (rc=$rc)" >> "$LOG/driver.log"
+    fi
+    # a killed/wedged client can poison the tunnel for the next step too:
+    # re-probe before the retry (or before handing on to the next step)
+    wait_probe
+  done
+}
+
+# jaxlint first: pure-host AST pass (the replication transport and fence
+# are host code — zero new device entry points to waive), zero chip time
+run_step jaxlint_r16    300 python scripts/mini_lint.py --jax raft_tpu --stats-json bench/JAXLINT.json
+# mosaic BEFORE anything that dispatches Pallas: re-validates the kernels
+# on hardware and stamps the sha-scoped artifact the dispatch gate needs
+run_step mosaic         900 env RAFT_MOSAIC_REQUIRE_TPU=1 python scripts/mosaic_check.py
+# the ship/fence/promote contract on the hardware backend (written to a
+# file first: run_step retries must not re-read stdin)
+cat > "$LOG/replication_smoke.py" <<'PY'
+import json, os, sys, tempfile
+
+sys.path.insert(0, os.getcwd())        # the queue runs this from /root/repo
+
+import jax
+import numpy as np
+from raft_tpu.neighbors import ivf_flat, mutation
+from raft_tpu.neighbors.wal import DurableStore
+from raft_tpu.serve import (FencedError, LogShipper, QueuePair,
+                            ReplicationConfig, SearchServer, ServerConfig,
+                            StandbyReplica)
+
+def leaves(t):
+    return [np.asarray(jax.device_get(x))
+            for x in jax.tree_util.tree_leaves(t)]
+
+db = np.random.default_rng(7).standard_normal((4096, 64)).astype(np.float32)
+idx = mutation.delete(
+    ivf_flat.build(db, ivf_flat.IvfFlatIndexParams(n_lists=16, seed=0)),
+    [2], id_space=2 * 4096)
+proot, sroot = tempfile.mkdtemp(), tempfile.mkdtemp()
+a, b = QueuePair.create()
+store = DurableStore.create(proot, idx)
+cfg = ReplicationConfig(ack_mode="semi_sync", ack_timeout_s=60.0)
+shipper = LogShipper(store, a, config=cfg)
+replica = StandbyReplica(sroot, b, config=cfg)
+shipper.pump(); replica.poll(); shipper.pump()   # cold snapshot bootstrap
+rng = np.random.default_rng(11)
+srv = SearchServer(replica.store.index, k=10,
+                   config=ServerConfig(ladder=(8,)))
+replica.attach_server(srv)
+replica.start()                                   # semi-sync needs live acks
+try:
+    store.extend(rng.standard_normal((256, 64)).astype(np.float32))
+    store.delete([5, 9])
+    store.compact()
+    store.extend(rng.standard_normal((64, 64)).astype(np.float32))
+finally:
+    replica.stop()
+while replica.poll(0.05):
+    pass
+assert replica.applied == store.wal_lsn == 4, replica.applied
+for x, y in zip(leaves(replica.store.index), leaves(store.index)):
+    np.testing.assert_array_equal(x, y)           # values AND ids
+promoted = replica.promote(drain_timeout_s=0.05)
+shipper.pump()                                    # fence reaches the primary
+fenced = 0
+for attempt in (lambda: store.extend(np.zeros((2, 64), np.float32)),
+                lambda: store.snapshot()):
+    try:
+        attempt()
+    except FencedError:
+        fenced += 1
+assert fenced == 2, fenced
+promoted.extend(rng.standard_normal((8, 64)).astype(np.float32))
+text = srv.prometheus_text()
+assert "raft_replication_lag_lsn" in text
+assert "raft_failovers_total" in text
+q = rng.standard_normal((4, 64)).astype(np.float32)
+d, i = srv.search(q)
+print(json.dumps({"config": "replication_smoke",
+                  "backend": jax.default_backend(),
+                  "bitwise_standby": True, "fenced_writes": fenced,
+                  "promoted_lsn": promoted.wal_lsn,
+                  "epoch": replica.fence.epoch}))
+PY
+run_step replication_smoke 900 python "$LOG/replication_smoke.py"
+# failover timing at serving scale: tail sweep on hardware; the final
+# JSON line becomes bench/FAILOVER_TPU.json next round
+run_step failover_bench 3600 env RAFT_BENCH_SERVE_ROWS=200000 \
+  RAFT_BENCH_SERVE_DIM=96 RAFT_BENCH_SERVE_LADDER=8,64 \
+  RAFT_BENCH_SERVE_FAILOVER=16,64,256,1024 python bench/serve.py
+run_step bench         4500 python bench.py
+echo "$(date) all steps attempted" >> "$LOG/driver.log"

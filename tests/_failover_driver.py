@@ -1,0 +1,137 @@
+"""Shared failover driver for ``tests/test_replication.py``.
+
+Two roles with ONE deterministic mutation schedule (the same
+one-module discipline as ``_durability_driver.py``):
+
+* **child** (``python tests/_failover_driver.py``, env ``FO_ROOT`` /
+  ``FO_PORT`` / ``FO_ACK_MODE`` / ``FO_CRASH_AT``): the PRIMARY.
+  Builds the seed store, connects a :class:`SocketTransport` back to
+  the parent's listener, attaches a :class:`LogShipper`, waits for the
+  standby's hello (which streams the snapshot bootstrap), then walks
+  the op list writing an atomically-renamed progress marker before
+  each op.  Op ``FO_CRASH_AT`` runs with a ``crash`` fault armed at
+  the ``wal_append`` site — ``os._exit(137)`` mid-mutation, before the
+  record exists anywhere, exactly like ``kill -9``.
+* **parent** (imported by the test): the STANDBY + the expectations.
+  ``expected_states(root)`` replays the same schedule fault-free; the
+  promoted standby is compared bit-for-bit against the rung matching
+  its applied watermark.
+
+The ack-mode contract the parent asserts:
+
+* ``semi_sync``: every op whose ``extend``/``delete``/``compact``
+  returned was acked first, so applied == marker exactly — zero acked
+  mutations lost;
+* ``async``: loss is bounded by the ship-queue backpressure window,
+  ``marker - applied <= ship_queue + 1`` (+1 for the record in flight
+  when the window check ran).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from _durability_driver import initial_tombstoned  # noqa: F401
+
+D = 8
+OP_COUNT = 7
+
+
+def op_list():
+    """Mutation-only schedule (replication ships mutations; snapshots
+    are checkpoint-local).  Deterministic: both roles call this."""
+    orng = np.random.default_rng(23)
+    ops = [
+        ("extend", (orng.standard_normal((16, D)).astype(np.float32),)),
+        ("delete", ([5, 9],)),
+        ("extend", (orng.standard_normal((8, D)).astype(np.float32),)),
+        ("compact", ()),
+        ("delete", ([30, 31],)),
+        ("extend", (orng.standard_normal((4, D)).astype(np.float32),)),
+        ("extend", (orng.standard_normal((4, D)).astype(np.float32),)),
+    ]
+    assert len(ops) == OP_COUNT
+    return ops
+
+
+def apply_op(store, op, args):
+    if op == "extend":
+        store.extend(*args)
+    elif op == "delete":
+        store.delete(*args)
+    elif op == "compact":
+        store.compact()
+    else:  # pragma: no cover — schedule typo guard
+        raise ValueError(op)
+
+
+def expected_states(root):
+    """``states[m]`` = the committed index after ops ``[0, m)``,
+    built with NO faults and NO replication."""
+    from raft_tpu.neighbors import wal
+
+    store = wal.DurableStore.create(root, initial_tombstoned())
+    states = [store.index]
+    for op, args in op_list():
+        apply_op(store, op, args)
+        states.append(store.index)
+    store.close()
+    return states
+
+
+def child_main():
+    from raft_tpu.neighbors import wal
+    from raft_tpu.serve.faults import FaultInjector
+    from raft_tpu.serve.replication import (LogShipper, ReplicationConfig,
+                                            SocketTransport)
+
+    root = os.environ["FO_ROOT"]
+    port = int(os.environ["FO_PORT"])
+    mode = os.environ.get("FO_ACK_MODE", "semi_sync")
+    crash_at = int(os.environ.get("FO_CRASH_AT", str(OP_COUNT - 1)))
+    queue = int(os.environ.get("FO_QUEUE", "256"))
+
+    store = wal.DurableStore.create(root, initial_tombstoned())
+    transport = SocketTransport.connect("127.0.0.1", port, timeout=60)
+    shipper = LogShipper(
+        store, transport,
+        config=ReplicationConfig(ack_mode=mode, ack_timeout_s=60.0,
+                                 ship_queue=queue))
+    # wait for the standby's hello: catch-up ships the cold bootstrap
+    # snapshot, so every later record lands on a warm follower
+    deadline = time.monotonic() + 60
+    while not store.followers() and time.monotonic() < deadline:
+        shipper.pump(0.1)
+    assert store.followers(), "standby never said hello"
+
+    marker = os.path.join(root, "progress")
+    for m, (op, args) in enumerate(op_list()):
+        if m == crash_at:
+            # arm mid-schedule: the drill is killing a primary that has
+            # already replicated a healthy prefix, not a newborn
+            store.faults = FaultInjector().arm("wal_append", "crash")
+        tmp = marker + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(m))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, marker)
+        apply_op(store, op, args)
+        shipper.pump(0.0)  # absorb acks opportunistically (async mode)
+    raise SystemExit(3)  # fault never fired — the parent asserts 137
+
+
+if __name__ == "__main__":
+    # mirror conftest.py: force CPU programmatically before backends
+    # initialize, same 8-virtual-device topology as the parent
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = \
+            (_flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    child_main()

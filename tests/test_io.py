@@ -1,6 +1,7 @@
 """IO layer tests: native C++ reader vs NumPy references (SURVEY.md §4's
 kernel-vs-naive-host-reference pattern applied to the IO subsystem)."""
 
+import os
 import shutil
 
 import numpy as np
@@ -173,3 +174,87 @@ def test_build_optout_env_is_quiet(monkeypatch):
         assert calls == []
     finally:
         native._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# sharded-store read robustness (ISSUE 15)
+
+
+def _sharded(tmp_path, rng, **open_kw):
+    from raft_tpu.io import shards
+
+    data = rng.standard_normal((64, 8)).astype(np.float32)
+    root = str(tmp_path / "store")
+    shards.write_store(root, data, rows_per_shard=16)
+    return shards.ShardedVectorStore.open(root, **open_kw), data, root
+
+
+def test_shard_gather_retries_transient_failures(tmp_path, rng):
+    from raft_tpu.obs.metrics import registry
+
+    st, data, _ = _sharded(tmp_path, rng)
+    counter = registry().counter("raft_ooc_shard_read_retries_total", "")
+    before = counter.value()
+    orig = st._read_with_retry
+    fails = {"left": 2}
+
+    def flaky_retry(what, fn):
+        def flaky():
+            if fails["left"] > 0:
+                fails["left"] -= 1
+                raise OSError(4, "interrupted system call")  # EINTR
+            return fn()
+        return orig(what, flaky)
+
+    st._read_with_retry = flaky_retry
+    got = st.gather(np.array([3, 21, 48]))
+    np.testing.assert_array_equal(got, data[[3, 21, 48]])
+    assert counter.value() - before == 2  # both transients were counted
+
+
+def test_shard_retry_budget_exhausts_loudly(tmp_path, rng):
+    from raft_tpu.io import shards
+
+    st, _, _ = _sharded(tmp_path, rng)
+
+    def always_fails():
+        raise OSError(5, "I/O error")
+
+    with pytest.raises(OSError):
+        st._read_with_retry("gather:test", always_fails)
+
+
+def test_shard_verify_on_gather_catches_bitflip(tmp_path, rng):
+    from raft_tpu.core.serialize import CorruptArtifact
+
+    st, data, root = _sharded(tmp_path, rng, verify_on_gather=True)
+    # clean store: verification passes and is cached per shard
+    np.testing.assert_array_equal(st.gather(np.array([17])), data[[17]])
+    shard1 = os.path.join(root, "shard-00001.npy")
+    with open(shard1, "r+b") as f:
+        f.seek(-3, os.SEEK_END)
+        byte = f.read(1)[0]
+        f.seek(-3, os.SEEK_END)
+        f.write(bytes([byte ^ 0xFF]))
+    # already-verified shard: the first-touch check does not re-run ...
+    np.testing.assert_array_equal(
+        np.asarray(st.gather(np.array([0]))), data[[0]])  # shard 0 clean
+    # ... but a fresh open sees the corruption on first touch
+    from raft_tpu.io import shards
+
+    st2 = shards.ShardedVectorStore.open(root, verify_on_gather=True)
+    with pytest.raises(CorruptArtifact):
+        st2.gather(np.array([17]))
+    # default mode stays permissive (checksums opt-in, as before)
+    st3 = shards.ShardedVectorStore.open(root)
+    assert st3.gather(np.array([17])).shape == (1, 8)
+
+
+def test_shard_verify_env_opt_in(tmp_path, rng, monkeypatch):
+    from raft_tpu.io import shards
+
+    _, _, root = _sharded(tmp_path, rng)
+    monkeypatch.setenv("RAFT_TPU_SHARD_VERIFY", "1")
+    assert shards.ShardedVectorStore.open(root).verify_on_gather
+    monkeypatch.delenv("RAFT_TPU_SHARD_VERIFY")
+    assert not shards.ShardedVectorStore.open(root).verify_on_gather

@@ -1,0 +1,471 @@
+"""Replicated durability drills (ISSUE 15).
+
+The acceptance criteria these pin:
+
+* a standby built ONLY from shipped WAL records (plus the cold snapshot
+  bootstrap) is bit-identical — values AND ids — to the primary, because
+  both fold mutations through the same ``DurableStore._apply``;
+* the ack-mode contract: ``semi_sync`` loses zero acked mutations across
+  a primary SIGKILL; ``async`` loss is bounded by the ship-queue window;
+* every wire failure heals deterministically: partition-dropped records
+  surface as gaps/heartbeat lag and trigger a watermark resync,
+  partition-dropped acks re-register via hello, semi-sync ack waits
+  degrade (counted) instead of wedging the primary;
+* fencing: a deposed primary's appends and swaps raise ``FencedError``
+  (counted), and a double promotion converges to exactly one serving
+  epoch;
+* replication lag and failover counts are scrapeable from
+  ``SearchServer.prometheus_text()``.
+
+The subprocess SIGKILL drill lives in ``tests/_failover_driver.py`` —
+the same module computes the parent's expected-state ladder, so the
+child's mutations and the parent's expectations are one code path.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+import _durability_driver as dur  # noqa: E402
+import _failover_driver as fo  # noqa: E402
+
+from raft_tpu.core.serialize import CorruptArtifact  # noqa: E402
+from raft_tpu.neighbors import mutation  # noqa: E402
+from raft_tpu.neighbors.wal import DurableStore  # noqa: E402
+from raft_tpu.obs.metrics import MetricRegistry  # noqa: E402
+from raft_tpu.serve import (CRASH_EXIT_CODE, EpochFence,  # noqa: E402
+                            EpochToken, FaultInjector, FencedError,
+                            LogShipper, Partitioned, QueuePair,
+                            ReplicationConfig, SearchServer, ServerConfig,
+                            SocketListener, StandbyReplica)
+from raft_tpu.serve.replication import (decode_message,  # noqa: E402
+                                        encode_message)
+
+D = fo.D
+
+
+def _leaves(tree):
+    return [np.asarray(jax.device_get(x))
+            for x in jax.tree_util.tree_leaves(tree)]
+
+
+def assert_bit_identical(a, b):
+    la, lb = _leaves(a), _leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        assert x.dtype == y.dtype and x.shape == y.shape
+        np.testing.assert_array_equal(x, y)
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _pair(tmp_path, mode="semi_sync", *, hello=True, **cfg_kw):
+    """Primary store + shipper wired to a cold standby over an
+    in-process queue pair, with separate metric registries."""
+    a, b = QueuePair.create()
+    pstore = DurableStore.create(tmp_path / "primary",
+                                 dur.initial_tombstoned())
+    cfg = ReplicationConfig(ack_mode=mode, **cfg_kw)
+    reg_p, reg_s = MetricRegistry(), MetricRegistry()
+    shipper = LogShipper(pstore, a, config=cfg, registry=reg_p)
+    replica = StandbyReplica(tmp_path / "standby", b, config=cfg,
+                             registry=reg_s, hello=hello)
+    return pstore, shipper, replica
+
+
+def _bootstrap(shipper, replica):
+    shipper.pump()   # hello -> cold catch-up ships a snapshot
+    replica.poll()   # standby installs it and acks the watermark
+    shipper.pump()   # primary records the ack
+    assert replica.store is not None, "bootstrap never landed"
+
+
+# ---------------------------------------------------------------------------
+# wire format
+
+
+def test_message_frame_roundtrip_and_crc():
+    blob = encode_message("record", {"x": np.arange(4, dtype=np.float32)},
+                          lsn=3, op="extend", node="p")
+    msg = decode_message(blob)
+    assert msg.kind == "record"
+    assert msg.static["lsn"] == 3 and msg.static["node"] == "p"
+    np.testing.assert_array_equal(msg.arrays["x"],
+                                  np.arange(4, dtype=np.float32))
+    bad = bytearray(blob)
+    bad[-1] ^= 0xFF  # payload bitflip -> crc mismatch
+    with pytest.raises(CorruptArtifact):
+        decode_message(bytes(bad))
+    with pytest.raises(CorruptArtifact):
+        decode_message(b"XXXX" + blob[4:])  # wrong magic
+
+
+def test_epoch_token_total_order_and_persistence(tmp_path):
+    assert EpochToken(1, "a") < EpochToken(1, "b") < EpochToken(2, "a")
+    f = EpochFence.load(tmp_path, "n1", writer=True)
+    assert f.epoch == 0 and not f.fenced
+    f.advance()
+    assert f.epoch == 1
+    f.observe(5, "other")
+    assert f.fenced
+    # both the claim and the highest seen epoch survive a restart
+    g = EpochFence.load(tmp_path, "n1", writer=True)
+    assert g.epoch == 1 and g.max_seen == EpochToken(5, "other") \
+        and g.fenced
+
+
+def test_partition_fault_kind_from_env():
+    inj = FaultInjector.from_env("ship_send:partition")
+    assert inj.pending("ship_send") == 1
+    with pytest.raises(Partitioned):
+        inj.fire("ship_send")
+    inj.fire("ship_send")  # consumed: healed, no-op
+
+
+# ---------------------------------------------------------------------------
+# ship bit-identity
+
+
+def test_cold_bootstrap_then_ship_bit_identity(tmp_path):
+    pstore, shipper, replica = _pair(tmp_path, "semi_sync",
+                                     ack_timeout_s=30.0)
+    _bootstrap(shipper, replica)
+    replica.start()  # semi-sync needs a live follower to ack
+    try:
+        for op, args in fo.op_list():
+            fo.apply_op(pstore, op, args)
+    finally:
+        replica.stop()
+    while replica.poll(0.05):
+        pass
+    assert replica.applied == pstore.wal_lsn == fo.OP_COUNT
+    # bit-identity three ways: standby == primary == fault-free replay
+    assert_bit_identical(replica.store.index, pstore.index)
+    states = fo.expected_states(tmp_path / "expected")
+    assert_bit_identical(replica.store.index, states[fo.OP_COUNT])
+    # semi-sync acked every record; lag is zero on both ends
+    assert shipper.metrics.counter(
+        "raft_replication_acks_total", "").value() >= fo.OP_COUNT
+    assert replica.lag() == {"lsn": 0.0, "seconds": 0.0}
+    shipper.pump()
+    assert pstore.follower_floor() == fo.OP_COUNT
+
+
+def test_warm_standby_restart_catches_up_from_watermark(tmp_path):
+    pstore, shipper, replica = _pair(tmp_path, "async")
+    _bootstrap(shipper, replica)
+    ops = fo.op_list()
+    for op, args in ops[:3]:
+        fo.apply_op(pstore, op, args)
+    replica.poll()
+    assert replica.applied == 3
+    replica.stop()
+    # standby restarts over the same root: recovers locally, then its
+    # hello asks only for the tail past its watermark
+    a, b = QueuePair.create()
+    shipper.transport = a
+    replica2 = StandbyReplica(tmp_path / "standby", b,
+                              config=replica.config,
+                              registry=MetricRegistry())
+    assert replica2.applied == 3  # local recovery, before any traffic
+    for op, args in ops[3:]:
+        fo.apply_op(pstore, op, args)
+    shipper.pump()   # hello -> tail catch-up (no snapshot re-ship)
+    replica2.poll()
+    assert replica2.applied == pstore.wal_lsn == len(ops)
+    assert_bit_identical(replica2.store.index, pstore.index)
+
+
+# ---------------------------------------------------------------------------
+# chaos: partitions, gaps, ack loss, timeouts
+
+
+def test_partition_gap_detected_and_resynced(tmp_path):
+    pstore, shipper, replica = _pair(tmp_path, "async")
+    _bootstrap(shipper, replica)
+    shipper.faults = FaultInjector().arm("ship_send", "partition")
+    ops = fo.op_list()
+    fo.apply_op(pstore, *ops[0])   # record 1 dropped on the wire
+    fo.apply_op(pstore, *ops[1])   # record 2 delivered -> gap
+    assert shipper.metrics.counter(
+        "raft_replication_drops_total", "").value() == 1
+    replica.poll()
+    assert replica.metrics.counter(
+        "raft_replication_gaps_total", "").value() == 1
+    assert replica.applied == 0     # never applied out of order
+    shipper.pump()                  # resync hello -> tail re-ship
+    replica.poll()
+    assert replica.applied == 2
+    assert_bit_identical(replica.store.index, pstore.index)
+
+
+def test_partition_all_records_healed_by_heartbeat(tmp_path):
+    pstore, shipper, replica = _pair(tmp_path, "async")
+    _bootstrap(shipper, replica)
+    shipper.faults = FaultInjector().arm("ship_send", "partition", times=2)
+    for op, args in fo.op_list()[:2]:
+        fo.apply_op(pstore, op, args)  # both drops: standby sees nothing
+    replica.poll()
+    assert replica.applied == 0
+    shipper.beat(force=True)  # lag surfaces on the next heartbeat
+    replica.poll()            # lsn 2 > applied 0 -> resync request
+    assert replica.lag()["lsn"] == 2.0
+    shipper.pump()
+    replica.poll()
+    assert replica.applied == 2
+    assert_bit_identical(replica.store.index, pstore.index)
+
+
+def test_ack_partition_reregisters_via_hello(tmp_path):
+    pstore, shipper, replica = _pair(tmp_path, "async")
+    _bootstrap(shipper, replica)
+    replica.faults = FaultInjector().arm("ship_ack", "partition")
+    fo.apply_op(pstore, *fo.op_list()[0])
+    replica.poll()              # applied, but the ack was dropped
+    assert replica.applied == 1
+    shipper.pump()
+    assert pstore.follower_floor() == 0  # primary never saw the ack
+    replica.hello()             # re-introduction carries the watermark
+    shipper.pump()
+    assert pstore.follower_floor() == 1
+
+
+def test_semi_sync_ack_timeout_degrades_not_wedges(tmp_path):
+    pstore, shipper, replica = _pair(tmp_path, "semi_sync",
+                                     ack_timeout_s=0.05)
+    _bootstrap(shipper, replica)
+    fo.apply_op(pstore, *fo.op_list()[0])  # standby never polls
+    # the mutation returned (no wedge) and the degrade was counted
+    assert pstore.wal_lsn == 1
+    assert shipper.metrics.counter(
+        "raft_replication_ack_timeouts_total", "").value() == 1
+
+
+def test_async_backpressure_bounds_unacked_window(tmp_path):
+    pstore, shipper, replica = _pair(tmp_path, "async", ship_queue=2,
+                                     ack_timeout_s=0.05)
+    _bootstrap(shipper, replica)
+    for op, args in fo.op_list()[:4]:
+        fo.apply_op(pstore, op, args)  # floor stuck at 0, window is 2
+    timeouts = shipper.metrics.counter(
+        "raft_replication_ack_timeouts_total", "").value()
+    assert timeouts >= 1  # lsn 3+ pushed past the window and waited
+    replica.poll()        # queue retained everything: full catch-up
+    assert replica.applied == 4
+    assert_bit_identical(replica.store.index, pstore.index)
+
+
+# ---------------------------------------------------------------------------
+# fencing + promotion
+
+
+def test_promotion_fences_deposed_primary(tmp_path):
+    pstore, shipper, replica = _pair(tmp_path, "async")
+    _bootstrap(shipper, replica)
+    for op, args in fo.op_list()[:2]:
+        fo.apply_op(pstore, op, args)
+    replica.poll()
+    store = replica.promote(drain_timeout_s=0.01)
+    assert replica.is_serving
+    assert_bit_identical(store.index, pstore.index)
+    shipper.pump()  # the fence announcement deposes the old primary
+    with pytest.raises(FencedError):
+        pstore.extend(np.zeros((2, D), np.float32))
+    with pytest.raises(FencedError):
+        pstore.snapshot()
+    assert pstore.counters["fenced_writes"] == 2
+    # the promoted store is a writable primary at the shipped lsn
+    store.extend(np.ones((2, D), np.float32))
+    assert store.wal_lsn == 3
+
+
+def test_double_promotion_converges_to_one_serving_epoch(tmp_path):
+    # two warm standbys (seeded with identical local state) race
+    for name in ("a", "b"):
+        DurableStore.create(tmp_path / name, dur.initial_tombstoned()).close()
+    ta, tb = QueuePair.create()
+    ra = StandbyReplica(tmp_path / "a", ta, node_id="a",
+                        registry=MetricRegistry(), hello=False)
+    rb = StandbyReplica(tmp_path / "b", tb, node_id="b",
+                        registry=MetricRegistry(), hello=False)
+    ra.promote(drain_timeout_s=0.01)
+    rb.promote(drain_timeout_s=0.01)  # drains ra's fence, outbids it
+    ra.poll()
+    rb.poll()
+    assert [ra.is_serving, rb.is_serving].count(True) == 1
+    assert rb.is_serving and ra.fence.fenced
+    assert ra.fence.max_seen == rb.fence.token
+
+
+def test_lease_expiry_detects_dead_primary(tmp_path):
+    clock = FakeClock()
+    a, b = QueuePair.create()
+    pstore = DurableStore.create(tmp_path / "primary",
+                                 dur.initial_tombstoned())
+    cfg = ReplicationConfig(lease_s=3.0)
+    shipper = LogShipper(pstore, a, config=cfg,
+                         registry=MetricRegistry(), clock=clock)
+    replica = StandbyReplica(tmp_path / "standby", b, config=cfg,
+                             registry=MetricRegistry(), clock=clock)
+    assert not replica.primary_alive()  # no traffic yet
+    _bootstrap(shipper, replica)
+    shipper.beat(force=True)
+    replica.poll()
+    assert replica.primary_alive()
+    clock.advance(2.9)
+    assert replica.primary_alive()
+    clock.advance(0.2)  # lease expired: 3.1s of silence
+    assert not replica.primary_alive()
+
+
+# ---------------------------------------------------------------------------
+# serving integration
+
+
+def test_standby_serves_bounded_staleness_reads(tmp_path):
+    pstore, shipper, replica = _pair(tmp_path, "async", refresh_every=2)
+    _bootstrap(shipper, replica)
+    srv = SearchServer(replica.store.index, k=3,
+                       config=ServerConfig(ladder=(4,)))
+    replica.attach_server(srv)
+    gen0 = srv.index
+    ops = fo.op_list()
+    fo.apply_op(pstore, *ops[0])
+    replica.poll()
+    assert srv.index is gen0          # 1 applied < refresh_every
+    fo.apply_op(pstore, *ops[1])
+    replica.poll()
+    assert srv.index is replica.store.index  # staleness bound hit: swap
+    q = np.random.default_rng(3).standard_normal((2, D)).astype(np.float32)
+    d_srv, i_srv = srv.search(q)
+    d_ref, i_ref = mutation.search(pstore.index, q, 3)
+    np.testing.assert_array_equal(np.asarray(d_srv),
+                                  np.asarray(jax.device_get(d_ref)))
+    np.testing.assert_array_equal(np.asarray(i_srv),
+                                  np.asarray(jax.device_get(i_ref)))
+
+
+def test_server_attach_replication_scrape_and_failover(tmp_path):
+    # primary server over a recovered durable store
+    DurableStore.create(tmp_path / "p", dur.initial_tombstoned()).close()
+    psrv = SearchServer.recover(tmp_path / "p", k=3,
+                                config=ServerConfig(ladder=(4,)))
+    a, b = QueuePair.create()
+    shipper = psrv.attach_replication("primary", a)
+    assert psrv.fence is shipper.fence and psrv.replication is shipper
+    # standby server wired via the same entry point
+    ssrv = SearchServer(dur.initial_tombstoned(), k=3,
+                        config=ServerConfig(ladder=(4,)))
+    replica = ssrv.attach_replication("standby", b, root=tmp_path / "s")
+    _bootstrap(shipper, replica)
+    fo.apply_op(psrv.durable_store, *fo.op_list()[0])
+    replica.poll()
+    shipper.pump()
+    text_p, text_s = psrv.prometheus_text(), ssrv.prometheus_text()
+    assert "raft_replication_acks_total" in text_p
+    assert "raft_replication_lag_lsn" in text_s
+    assert "raft_replication_lag_seconds" in text_s
+    assert "raft_failovers_total" in text_s
+    assert psrv.metrics.registry.counter(
+        "raft_replication_acks_total", "").value() >= 1
+    assert replica.lag() == {"lsn": 0.0, "seconds": 0.0}
+    # failover: standby promotes, old server's swap is fenced
+    replica.promote(drain_timeout_s=0.01)
+    shipper.pump()
+    assert "raft_failovers_total" in ssrv.prometheus_text()
+    assert ssrv.metrics.registry.counter(
+        "raft_failovers_total", "").value() == 1
+    with pytest.raises(FencedError):
+        psrv.swap_index(dur.initial_tombstoned())
+    assert psrv.metrics.counter_value("fenced_writes") == 1
+    # the promoted server answers from the replicated generation
+    q = np.random.default_rng(5).standard_normal((2, D)).astype(np.float32)
+    d_new, i_new = ssrv.search(q)
+    d_ref, i_ref = mutation.search(replica.store.index, q, 3)
+    np.testing.assert_array_equal(np.asarray(d_new),
+                                  np.asarray(jax.device_get(d_ref)))
+    np.testing.assert_array_equal(np.asarray(i_new),
+                                  np.asarray(jax.device_get(i_ref)))
+
+
+# ---------------------------------------------------------------------------
+# the SIGKILL failover drill (subprocess, socket transport)
+
+
+def _run_failover_child(root, port, mode, crash_at):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, FO_ROOT=str(root), FO_PORT=str(port),
+               FO_ACK_MODE=mode, FO_CRASH_AT=str(crash_at),
+               PYTHONPATH=os.pathsep.join(
+                   p for p in (repo, os.path.dirname(os.path.abspath(
+                       __file__)), os.environ.get("PYTHONPATH")) if p))
+    return subprocess.Popen(
+        [sys.executable, os.path.join(os.path.dirname(__file__),
+                                      "_failover_driver.py")],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+
+@pytest.mark.parametrize("mode", ["semi_sync", "async"])
+def test_sigkill_failover_promoted_standby_bit_identical(mode, tmp_path):
+    crash_at = fo.OP_COUNT - 2
+    listener = SocketListener()
+    proc = _run_failover_child(tmp_path / "primary", listener.port, mode,
+                               crash_at)
+    try:
+        transport = listener.accept(timeout=120)
+        replica = StandbyReplica(tmp_path / "standby", transport,
+                                 config=ReplicationConfig(ack_mode=mode),
+                                 registry=MetricRegistry())
+        replica.start()
+        _, err = proc.communicate(timeout=540)
+        assert proc.returncode == CRASH_EXIT_CODE, \
+            f"child should die at the armed wal_append site " \
+            f"(rc={proc.returncode}):\n{err[-2000:]}"
+        replica.stop()
+        while replica.poll(0.2):  # drain what TCP already delivered
+            pass
+    finally:
+        proc.kill()
+        listener.close()
+    m = int((tmp_path / "primary" / "progress").read_text())
+    assert m == crash_at  # the schedule reached the armed op
+    w = replica.applied
+    if mode == "semi_sync":
+        # zero acked mutations lost: every completed op reached the
+        # standby before its mutator returned
+        assert w == m, f"semi_sync lost acked records (applied {w} of {m})"
+    else:
+        assert w <= m
+        assert m - w <= replica.config.ship_queue + 1, \
+            f"async loss {m - w} exceeds the ship-queue bound"
+    store = replica.promote(drain_timeout_s=0.05)
+    assert replica.is_serving
+    states = fo.expected_states(tmp_path / "expected")
+    assert_bit_identical(store.index, states[w])
+    # search-results identity — values AND ids — at the acked watermark
+    q = np.random.default_rng(17).standard_normal((3, D)).astype(np.float32)
+    d_new, i_new = mutation.search(store.index, q, 3)
+    d_ref, i_ref = mutation.search(states[w], q, 3)
+    np.testing.assert_array_equal(np.asarray(jax.device_get(d_new)),
+                                  np.asarray(jax.device_get(d_ref)))
+    np.testing.assert_array_equal(np.asarray(jax.device_get(i_new)),
+                                  np.asarray(jax.device_get(i_ref)))
+    # the promoted store is a writable primary: life goes on
+    store.extend(np.ones((2, D), np.float32))
+    assert store.wal_lsn == w + 1

@@ -388,3 +388,66 @@ def test_brute_compact_plain_and_empty_guard(db):
     t = mutation.delete(db[:4], [0, 1, 2, 3], id_space=4)
     with pytest.raises(RaftError):
         mutation.compact(t)  # dropping every row is a refusal, not (0, d)
+
+
+# ---------------------------------------------------------------------------
+# WAL pruning (ISSUE 15): the follower-ack floor
+
+
+def test_wal_prune_retains_newest_record_and_resumes_lsn(tmp_path):
+    path = tmp_path / "wal.log"
+    w = WriteAheadLog(path)
+    for _ in range(5):
+        w.append("compact", {}, {})
+    # asking past the end still keeps the newest record: a reopen must
+    # be able to resume the LSN sequence from the file alone
+    assert w.prune(99) == 4
+    records, _, problems = read_wal(path)
+    assert problems == [] and [r.lsn for r in records] == [5]
+    assert w.append("compact", {}, {}) == 6
+    w.close()
+    w2 = WriteAheadLog(path)
+    assert w2.lsn == 6
+    w2.close()
+    # pruning below the oldest retained record is a no-op
+    w3 = WriteAheadLog(path)
+    assert w3.prune(4) == 0
+    w3.close()
+
+
+def test_store_prune_wal_floors_at_follower_ack(tmp_path, built):
+    # retain=1: only the mid-history snapshot (watermark lsn 2) remains,
+    # so the snapshot floor alone would discard records 1 AND 2
+    store = _store_with_history(tmp_path, built, retain=1)
+    assert [r.lsn for r in read_wal(store.wal.path)[0]] == [1, 2, 3, 4]
+    # a slow follower caps the floor: prune may not discard past its ack
+    store.register_follower("standby", 1)
+    assert store.prune_wal() == 1
+    assert [r.lsn for r in read_wal(store.wal.path)[0]] == [2, 3, 4], \
+        "record 2 (> follower ack 1) must survive"
+    # the follower catches up: the floor rises to the snapshot watermark
+    store.follower_acked("standby", store.wal_lsn)
+    assert store.prune_wal() == 1
+    assert [r.lsn for r in read_wal(store.wal.path)[0]] == [3, 4]
+    assert store.counters["wal_pruned"] == 2
+    # replay from the retained snapshot + tail recovers bit-identically
+    store.close()
+    re = DurableStore.recover(tmp_path / "dur")
+    ref = _store_with_history(tmp_path / "ref", built)
+    assert_bit_identical(re.index, ref.index)
+    re.close()
+    ref.close()
+
+
+def test_store_prune_wal_registry_lifecycle(tmp_path, built):
+    store = _store_with_history(tmp_path, built)
+    store.register_follower("a", 2)
+    store.register_follower("b", 4)
+    assert store.follower_floor() == 2
+    store.follower_acked("a", 1)  # acks are monotonic: never regress
+    assert store.followers()["a"] == 2
+    store.drop_follower("a")
+    assert store.follower_floor() == 4
+    store.drop_follower("b")
+    assert store.follower_floor() is None
+    store.close()
