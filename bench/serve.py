@@ -36,6 +36,35 @@ Scale knobs (CPU smoke → TPU record):
                              answered query) is timed — the
                              snapshot-cadence sizing curve.  Final JSON
                              metric: serve_recovery_s (ivf_flat only)
+  RAFT_BENCH_SERVE_REPLICAS  fleet mode (replaces the sweep): comma list
+                             of replica counts (e.g. "1,2,4"); each
+                             point spawns that many WORKER SUBPROCESSES
+                             (own interpreter, own SearchServer), wires
+                             them to this coordinator over the
+                             replication wire protocol (SocketListener /
+                             SocketTransport + encode/decode_message),
+                             and drives a closed loop through a least-
+                             outstanding router — aggregate QPS@p95 vs
+                             replica count, plus a SIGKILL drill at 2
+                             replicas asserting the router sheds to the
+                             survivor with ZERO dropped in-deadline
+                             requests.  Final JSON metric:
+                             serve_fleet_qps_at_p95_budget, with the
+                             2-vs-1 scaling ratio asserted >= 1.6x at
+                             unchanged p95 (the ISSUE 16 ratchet).
+                             Replicas here are processes on one host;
+                             on a pod each worker is one accelerator
+                             host running the same protocol.
+  RAFT_BENCH_SERVE_FLEET_CLIENTS   closed-loop clients per replica
+                             (default 6 — under the smallest >1 ladder
+                             bucket, so the batcher's hold-open window,
+                             not single-core compute, sets the cadence
+                             and replicas overlap their windows)
+  RAFT_BENCH_SERVE_FLEET_WAIT_MS   per-replica batching window in fleet
+                             mode (default 15 ms: wait-dominated on
+                             purpose — the sweep measures fan-out
+                             scaling, and the window is what an online
+                             pod trades for batch fill anyway)
   RAFT_BENCH_SERVE_FAILOVER  failover-time mode (replaces the sweep):
                              comma list of WAL tail lengths; for each, a
                              warm standby accumulates that many shipped-
@@ -50,9 +79,12 @@ Scale knobs (CPU smoke → TPU record):
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
+import subprocess
 import sys
+import tempfile
 import threading
 import time
 
@@ -83,10 +115,19 @@ LADDER = tuple(int(b) for b in
 SWAPS = int(os.environ.get("RAFT_BENCH_SERVE_SWAPS", 0))
 RECOVERY = os.environ.get("RAFT_BENCH_SERVE_RECOVERY", "")
 FAILOVER = os.environ.get("RAFT_BENCH_SERVE_FAILOVER", "")
+REPLICAS = os.environ.get("RAFT_BENCH_SERVE_REPLICAS", "")
+FLEET_CLIENTS = int(os.environ.get("RAFT_BENCH_SERVE_FLEET_CLIENTS", 6))
+FLEET_WAIT_MS = float(os.environ.get("RAFT_BENCH_SERVE_FLEET_WAIT_MS", 15.0))
 
 # the mixed-shape request mix: point lookups dominate, small batches
 # common, bulk occasional — the traffic the bucket ladder is shaped for
 _SHAPES = (1, 1, 1, 2, 4, 8, 8, 16, 32, 64)
+
+# fleet mode measures the interactive tier only: point lookups + pairs,
+# kept under the top ladder bucket so each replica's cadence is its
+# batcher's hold-open window (the thing replicas overlap) rather than
+# bulk-batch compute, which belongs to the single-server sweep above
+_FLEET_SHAPES = (1, 1, 1, 2)
 
 
 def _build_index(db):
@@ -361,6 +402,353 @@ def run_failover(spec: str = FAILOVER) -> dict:
     return final
 
 
+# -- fleet mode: subprocess replicas behind a coordinator router --------
+#
+# The wire protocol is the replication stack's own framing
+# (encode_message / decode_message over SocketTransport — CRC-checked,
+# torn-frame-safe), with three request kinds:
+#   fleet_search  coordinator -> worker   {q} + req_id, deadline_ms
+#   fleet_reply   worker -> coordinator   req_id, ok [, err]
+#   fleet_quit / fleet_bye                orderly shutdown + final stats
+# Replies carry only ok/err back to the closed loop (the coordinator
+# times the round trip; it does not re-verify payloads the serve suite
+# already pins bit-identical), but dist/ids ride along so the drill is
+# an end-to-end answer, not an ack.
+
+
+def run_fleet_worker() -> None:
+    """One replica process: build the same index every replica builds
+    (same seed — replicas are peers, not shards), serve it through a
+    SearchServer, and answer coordinator frames until quit/EOF."""
+    import queue as queue_mod
+
+    from raft_tpu.serve import SearchServer, ServerConfig, SocketTransport
+    from raft_tpu.serve.replication import encode_message
+
+    name = os.environ["RAFT_BENCH_FLEET_NAME"]
+    port = int(os.environ["RAFT_BENCH_FLEET_PORT"])
+    rng = np.random.default_rng(0)
+    db = rng.standard_normal((ROWS, DIM)).astype(np.float32)
+    index, params = _build_index(db)
+    cfg = ServerConfig(ladder=LADDER, max_wait_ms=FLEET_WAIT_MS,
+                       max_queue=max(256, 32 * FLEET_CLIENTS))
+    srv = SearchServer(index, k=K, params=params, config=cfg)
+    srv.start()  # ladder warmed before hello: startup is not measured
+    link = SocketTransport.connect("127.0.0.1", port)
+    link.send(encode_message("fleet_hello", name=name, pid=os.getpid()))
+
+    work: "queue_mod.Queue" = queue_mod.Queue()
+
+    def handle() -> None:
+        while True:
+            item = work.get()
+            if item is None:
+                return
+            rid, q, deadline_ms = item
+            try:
+                d, i = srv.submit(q, deadline_ms=deadline_ms).result(
+                    timeout=30)
+                link.send(encode_message(
+                    "fleet_reply",
+                    {"dist": np.asarray(jax.device_get(d)),
+                     "ids": np.asarray(jax.device_get(i))},
+                    req_id=rid, ok=True))
+            except OSError:
+                return  # coordinator gone: nothing to reply to
+            except Exception as e:  # rejection crosses the wire as a name
+                try:
+                    link.send(encode_message("fleet_reply", req_id=rid,
+                                             ok=False,
+                                             err=type(e).__name__))
+                except OSError:
+                    return
+
+    pool = [threading.Thread(target=handle, daemon=True) for _ in range(8)]
+    for t in pool:
+        t.start()
+    try:
+        while True:
+            msg = link.recv(timeout=1.0)
+            if msg is None:
+                if link.closed:
+                    break  # coordinator died: exit quietly
+                continue
+            if msg.kind == "fleet_search":
+                work.put((msg.static["req_id"], msg.arrays["q"],
+                          msg.static.get("deadline_ms")))
+            elif msg.kind == "fleet_quit":
+                break
+    finally:
+        for _ in pool:
+            work.put(None)
+        for t in pool:
+            t.join(timeout=10)
+        snap = srv.metrics_snapshot()
+        try:
+            link.send(encode_message(
+                "fleet_bye", name=name, completed=snap["completed"],
+                batches=snap["batches"],
+                batch_fill_ratio=snap["batch_fill_ratio"],
+                p95_ms=snap["latency_ms"]["p95"]))
+        except OSError:
+            pass
+        srv.stop()
+        link.close()
+
+
+class _WorkerGone(Exception):
+    """Raised by the coordinator-side handle when its replica process is
+    unreachable — the router's cue to shed and retry a survivor."""
+
+
+class _FleetWorker:
+    """Coordinator-side replica handle: one socket, one receiver thread
+    completing per-request slots, died-peer detection failing them."""
+
+    def __init__(self, name: str, proc, link) -> None:
+        self.name, self.proc, self.link = name, proc, link
+        self.alive = True
+        self.bye = None
+        self._pending: dict = {}  # req_id -> [event, ok, err]
+        self._lock = threading.Lock()
+        self._rx = threading.Thread(target=self._recv_loop, daemon=True)
+        self._rx.start()
+
+    def outstanding(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def submit(self, rid: str, q, deadline_ms: float):
+        from raft_tpu.serve.replication import encode_message
+
+        slot = [threading.Event(), False, None]
+        with self._lock:
+            if not self.alive:
+                raise _WorkerGone(self.name)
+            self._pending[rid] = slot
+        try:
+            self.link.send(encode_message("fleet_search", {"q": q},
+                                          req_id=rid,
+                                          deadline_ms=deadline_ms))
+        except OSError:
+            self._mark_dead()
+            raise _WorkerGone(self.name)
+        return slot
+
+    def _recv_loop(self) -> None:
+        while True:
+            msg = self.link.recv(timeout=0.5)
+            if msg is None:
+                if self.link.closed:
+                    self._mark_dead()
+                    return
+                continue
+            if msg.kind == "fleet_reply":
+                with self._lock:
+                    slot = self._pending.pop(msg.static["req_id"], None)
+                if slot is not None:
+                    slot[1] = bool(msg.static.get("ok"))
+                    slot[2] = msg.static.get("err")
+                    slot[0].set()
+            elif msg.kind == "fleet_bye":
+                self.bye = dict(msg.static)
+
+    def _mark_dead(self) -> None:
+        with self._lock:
+            self.alive = False
+            slots = list(self._pending.values())
+            self._pending.clear()
+        for slot in slots:
+            slot[1], slot[2] = False, "worker_gone"
+            slot[0].set()
+
+
+def _spawn_fleet(n: int, listener):
+    """Launch ``n`` replica subprocesses and wait for every hello — the
+    measured window starts only once the whole pod is warm."""
+    procs = {}
+    for i in range(n):
+        env = dict(os.environ,
+                   RAFT_BENCH_FLEET_PORT=str(listener.port),
+                   RAFT_BENCH_FLEET_NAME=f"r{i}",
+                   JAX_PLATFORMS=jax.default_backend())
+        log = open(os.path.join(tempfile.gettempdir(),
+                                f"raft-fleet-worker-{i}.log"), "wb")
+        p = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--fleet-worker"],
+            env=env, stdout=log, stderr=subprocess.STDOUT)
+        log.close()
+        procs[p.pid] = p
+    workers = []
+    for _ in range(n):
+        link = listener.accept(timeout=600.0)
+        msg = link.recv(timeout=600.0)
+        assert msg is not None and msg.kind == "fleet_hello", msg
+        workers.append(_FleetWorker(msg.static["name"],
+                                    procs[msg.static["pid"]], link))
+    workers.sort(key=lambda w: w.name)
+    return workers
+
+
+def _shutdown_fleet(workers) -> None:
+    from raft_tpu.serve.replication import encode_message
+
+    for w in workers:
+        if w.alive:
+            try:
+                w.link.send(encode_message("fleet_quit"))
+            except OSError:
+                pass
+    deadline = time.monotonic() + 15.0
+    for w in workers:
+        while (w.alive and w.bye is None
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        try:
+            w.proc.terminate()
+        except OSError:
+            pass
+        w.proc.wait(timeout=15)
+        w.link.close()
+
+
+def _fleet_point(workers, n_clients: int, seconds: float,
+                 kill_after=None) -> dict:
+    """Closed loop against the pod: each client routes to the least-
+    outstanding live replica, retries a failed attempt on a survivor
+    while its deadline is open, and only a terminal failure with time
+    still on the clock counts as dropped-in-deadline (contract: zero)."""
+    stop = threading.Event()
+    lock = threading.Lock()
+    lat_ms: list = []
+    stats = {"completed": 0, "rerouted": 0, "dropped_in_deadline": 0,
+             "expired": 0}
+    rid_counter = itertools.count()
+
+    def pick():
+        live = [w for w in workers if w.alive]
+        return min(live, key=_FleetWorker.outstanding) if live else None
+
+    def client(j: int) -> None:
+        rng = np.random.default_rng(5000 + j)
+        while not stop.is_set():
+            rows = int(rng.choice(_FLEET_SHAPES))
+            q = rng.standard_normal((rows, DIM)).astype(np.float32)
+            t0 = time.perf_counter()
+            deadline = t0 + 10 * BUDGET_MS / 1e3
+            ok = False
+            while True:
+                now = time.perf_counter()
+                if now >= deadline:
+                    with lock:
+                        stats["expired"] += 1
+                    break
+                w = pick()
+                if w is None:  # whole pod dead with time on the clock
+                    with lock:
+                        stats["dropped_in_deadline"] += 1
+                    break
+                try:
+                    slot = w.submit(f"{j}.{next(rid_counter)}", q,
+                                    1e3 * (deadline - now))
+                except _WorkerGone:
+                    with lock:
+                        stats["rerouted"] += 1
+                    continue
+                slot[0].wait(timeout=deadline - time.perf_counter() + 0.25)
+                if slot[1]:
+                    ok = True
+                    break
+                with lock:  # replica died or rejected: try a survivor
+                    stats["rerouted"] += 1
+            if ok:
+                with lock:
+                    stats["completed"] += 1
+                    lat_ms.append(1e3 * (time.perf_counter() - t0))
+
+    threads = [threading.Thread(target=client, args=(j,), daemon=True)
+               for j in range(n_clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    if kill_after is not None:
+        time.sleep(kill_after)
+        victim = workers[0]
+        victim.proc.kill()  # SIGKILL: no goodbye, the socket just dies
+        victim.proc.wait(timeout=15)
+        time.sleep(max(0.0, seconds - kill_after))
+    else:
+        time.sleep(seconds)
+    stop.set()
+    for t in threads:
+        t.join(timeout=60)
+    dt = time.perf_counter() - t0
+    lat_ms.sort()
+    return {
+        "qps": round(stats["completed"] / dt, 1),
+        "p95_ms": round(lat_ms[int(0.95 * (len(lat_ms) - 1))], 3)
+        if lat_ms else None,
+        **stats,
+    }
+
+
+def run_fleet(spec: str = REPLICAS) -> dict:
+    """Replica-count sweep + SIGKILL drill; asserts the ISSUE 16
+    ratchet (>=1.6x aggregate QPS at 2 replicas vs 1, p95 unchanged,
+    zero dropped in-deadline requests through the kill)."""
+    from raft_tpu.serve import SocketListener
+
+    counts = tuple(int(c) for c in spec.split(","))
+    points, qps_by, p95_by = [], {}, {}
+    drill = None
+    for n in counts:
+        listener = SocketListener()
+        workers = _spawn_fleet(n, listener)
+        try:
+            point = _fleet_point(workers, FLEET_CLIENTS * n, SECONDS)
+            point = {"config": "fleet_sweep", "replicas": n,
+                     "clients": FLEET_CLIENTS * n, **point}
+            points.append(point)
+            qps_by[n], p95_by[n] = point["qps"], point["p95_ms"]
+            print(json.dumps(point), flush=True)
+            if n == 2 and drill is None:
+                # reuse the warm pair: kill r0 mid-load, shed to r1
+                drill = _fleet_point(workers, FLEET_CLIENTS * 2,
+                                     SECONDS + 2.0, kill_after=1.0)
+                drill = {"config": "fleet_drill", "replicas": n,
+                         "killed": workers[0].name, **drill}
+                print(json.dumps(drill), flush=True)
+                assert drill["dropped_in_deadline"] == 0, drill
+                assert drill["expired"] == 0, drill
+                assert drill["rerouted"] > 0, \
+                    "kill drill never exercised the shed path"
+        finally:
+            _shutdown_fleet(workers)
+            listener.close()
+    if 1 in qps_by and 2 in qps_by:
+        ratio = qps_by[2] / qps_by[1]
+        assert ratio >= 1.6, f"2-replica scaling {ratio:.2f}x < 1.6x"
+        assert p95_by[2] <= BUDGET_MS, p95_by
+        assert p95_by[2] <= 1.5 * p95_by[1] + 2.0, \
+            f"p95 moved: {p95_by[1]} -> {p95_by[2]} ms"
+    top = max(qps_by)
+    final = {
+        "metric": "serve_fleet_qps_at_p95_budget",
+        "value": qps_by[top],
+        "unit": f"qps@{top}replicas,p95<={BUDGET_MS:g}ms",
+        "scaling_x2": round(qps_by[2] / qps_by[1], 2)
+        if 1 in qps_by and 2 in qps_by else None,
+        "family": FAMILY, "rows": ROWS, "dim": DIM, "k": K,
+        "ladder": list(LADDER), "fleet_wait_ms": FLEET_WAIT_MS,
+        "clients_per_replica": FLEET_CLIENTS,
+        "backend": jax.default_backend(),
+        "points": points,
+        "drill": drill,
+    }
+    print(json.dumps(final), flush=True)
+    return final
+
+
 def run(seconds: float = SECONDS, clients=CLIENTS) -> dict:
     """Build index, start server, sweep concurrency; returns the final
     result dict (also printed as the last JSON line)."""
@@ -420,7 +808,11 @@ def run(seconds: float = SECONDS, clients=CLIENTS) -> dict:
 
 
 if __name__ == "__main__":
-    if RECOVERY:
+    if "--fleet-worker" in sys.argv:
+        run_fleet_worker()
+    elif REPLICAS:
+        run_fleet()
+    elif RECOVERY:
         run_recovery()
     elif FAILOVER:
         run_failover()

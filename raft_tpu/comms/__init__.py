@@ -29,7 +29,8 @@ from .comms import (
     multicast_sendrecv,
     barrier,
 )
-from .bootstrap import init_distributed, inject_comms_on_resources
+from .bootstrap import (init_distributed, inject_comms_on_resources,
+                        verify_comms)
 from .ring import ring_topk_merge
 from . import selftest
 
@@ -53,5 +54,6 @@ __all__ = [
     "barrier",
     "init_distributed",
     "inject_comms_on_resources",
+    "verify_comms",
     "selftest",
 ]

@@ -11,15 +11,18 @@ mesh → handle injection, SURVEY.md §3.2).  On TPU the whole stack collapses:
 
 from __future__ import annotations
 
+import math
 from typing import Optional, Sequence
 
 import jax
 
 from ..core import resources as res_mod
+from ..core.errors import expects
 from ..core.mesh import make_mesh
 from .comms import Comms
 
-__all__ = ["init_distributed", "inject_comms_on_resources"]
+__all__ = ["init_distributed", "inject_comms_on_resources",
+           "verify_comms"]
 
 
 def init_distributed(
@@ -50,11 +53,48 @@ def init_distributed(
         if len(axis_names) != 1:
             raise ValueError("axis_shape required for multi-axis meshes")
         axis_shape = (len(devices),)
+    else:
+        # a shape that doesn't tile the device set used to slip through
+        # and make_mesh silently meshed SOME of jax.devices() — a fleet
+        # bootstrapped that way shards an index over a sub-pod while the
+        # rest idles (or make_mesh raises an opaque reshape error).
+        # Validate here, where the operator's intent (axis_shape) and
+        # the runtime reality (visible devices) first meet.
+        expects(len(axis_shape) == len(axis_names),
+                f"axis_shape {tuple(axis_shape)} has {len(axis_shape)} "
+                f"axes but axis_names {tuple(axis_names)} has "
+                f"{len(axis_names)}")
+        want = math.prod(int(s) for s in axis_shape)
+        if want != len(devices):
+            raise ValueError(
+                f"axis_shape {tuple(axis_shape)} covers {want} devices "
+                f"but this process sees {len(devices)} "
+                f"({jax.default_backend()} backend) — the mesh must use "
+                "every visible device; pass an axis_shape whose product "
+                f"is {len(devices)}, or restrict visible devices first")
     mesh = make_mesh(tuple(axis_shape), tuple(axis_names))
     comms = Comms(mesh)
     target = res_mod._resolve(res)
     inject_comms_on_resources(target, comms)
     return comms
+
+
+def verify_comms(comms: Comms) -> dict:
+    """Run the :mod:`.selftest` battery over a bootstrapped communicator
+    and raise with the failing verb names if any collective is broken —
+    the fleet-startup gate (``FleetServer`` refuses to serve over a mesh
+    whose collectives disagree with the single-device reference).
+    Returns the full ``{test_name: bool}`` map on success."""
+    from . import selftest
+
+    results = selftest.run_all(comms)
+    failed = sorted(name for name, ok in results.items() if not ok)
+    if failed:
+        raise RuntimeError(
+            f"comms selftest failed on {comms.mesh.shape} mesh: "
+            f"{', '.join(failed)} — refusing to serve over a broken "
+            "collective (check device topology / runtime version)")
+    return results
 
 
 def inject_comms_on_resources(res: res_mod.Resources, comms: Comms) -> None:

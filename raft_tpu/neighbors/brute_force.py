@@ -22,6 +22,7 @@ allgather over ``comms_t``, SURVEY.md §5.7).
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from functools import partial
 from typing import Optional, Tuple
@@ -34,8 +35,10 @@ from ..core import tracing
 from ..core.array import wrap_array
 from ..core.compat import shard_map
 from ..core.errors import expects
+from ..ops.blocked_scan import row_sq_norms as _scan_norms
 
-__all__ = ["knn", "knn_sharded", "searcher", "tile_knn_merge"]
+__all__ = ["knn", "knn_sharded", "searcher", "tile_knn_merge",
+           "fleet_slices", "BruteFleetSlices"]
 
 _NEG_INF = jnp.float32(-jnp.inf)
 
@@ -70,7 +73,7 @@ def _tile_distances(x, yt, metric: str, xn=None):
     if metric == "inner_product":
         return _metric_from_dots(dots, None, None, metric)
     ytf = yt.astype(jnp.float32)
-    yn = jnp.sum(ytf * ytf, axis=1)
+    yn = _scan_norms(ytf)
     return _metric_from_dots(dots, xn, yn[None, :], metric)
 
 
@@ -98,7 +101,7 @@ def _knn_impl(x, y, k: int, metric: str, tile: int,
                 jnp.pad(keep, ((0, 0), (0, pad)), constant_values=False)
                 .reshape(m, -1, tile), 1, 0)
     xf = x.astype(jnp.float32)
-    xn = jnp.sum(xf * xf, axis=1)
+    xn = _scan_norms(xf)
 
     kk = min(k, tile)
 
@@ -146,8 +149,8 @@ def _exact_candidate_distances(x, yc, metric: str, precision=None):
                           precision=precision or jax.lax.Precision.HIGHEST)
     if metric == "inner_product":
         return _metric_from_dots(dots, None, None, metric)
-    xn = jnp.sum(xf * xf, axis=1)
-    yn = jnp.sum(ycf * ycf, axis=2)
+    xn = _scan_norms(xf)
+    yn = _scan_norms(ycf)
     return _metric_from_dots(dots, xn, yn, metric)
 
 
@@ -564,3 +567,58 @@ def knn_sharded(
     if keep is not None:
         di = sentinel_filtered_ids(dv, di)
     return dv, di
+
+
+@dataclasses.dataclass(frozen=True)
+class BruteFleetSlices:
+    """Device-mesh layout of a brute-force database for the serving
+    fleet (:mod:`raft_tpu.serve.fleet`): rows padded to a multiple of
+    the mesh axis and laid out contiguously — shard *s* owns global rows
+    ``[s*per, (s+1)*per)`` — plus a sharded validity mask with the pad
+    rows False (global ids for brute force ARE row positions, so the
+    mask doubles as the filter carrier: a user prefilter is padded and
+    sharded the same way, then ANDed in)."""
+
+    data: jax.Array    # [S*per, d] sharded P(axis)
+    mask: jax.Array    # [S*per] bool sharded P(axis); pad rows False
+    n: int             # original row count
+    per: int           # rows per shard
+
+
+def fleet_slices(database, mesh: Mesh, *, axis: str = "shard",
+                 filter=None) -> BruteFleetSlices:
+    """Slice a brute-force database over ``mesh[axis]`` for the fleet
+    fan-out.  Host (numpy) input is padded in numpy and ``device_put``
+    with the target sharding, so the single-device peak is one shard.
+    Pad rows are ZEROS under a False mask — unlike
+    :func:`._packing.shard_rows` (which tiles row 0 for build pipelines
+    that track validity by count), a serving shard must never score a
+    duplicated real row."""
+    import numpy as np
+    from jax.sharding import NamedSharding
+
+    from ._packing import as_keep_mask
+
+    y = database if isinstance(database, jax.Array) else np.asarray(database)
+    expects(y.ndim == 2, "database must be [n, d]")
+    n, d = y.shape
+    n_dev = int(mesh.shape[axis])
+    per = (n + n_dev - 1) // n_dev
+    pad = per * n_dev - n
+    keep = as_keep_mask(filter, n=n)
+    if keep is not None:
+        expects(keep.ndim == 1,
+                "fleet filters are shared bitsets (1-D) over rows")
+        mask = np.asarray(keep).astype(bool)
+    else:
+        mask = np.ones((n,), bool)
+    if pad:
+        zeros = (jnp.zeros if isinstance(y, jax.Array) else np.zeros)
+        cat = (jnp.concatenate if isinstance(y, jax.Array)
+               else np.concatenate)
+        y = cat([y, zeros((pad, d), y.dtype)], axis=0)
+        mask = np.concatenate([mask, np.zeros((pad,), bool)])
+    sh = NamedSharding(mesh, P(axis))
+    return BruteFleetSlices(jax.device_put(y, sh),
+                            jax.device_put(jnp.asarray(mask), sh),
+                            int(n), int(per))

@@ -618,7 +618,9 @@ def _search_impl(dataset, graph, routers, router_nodes, q, key, iters_cap,
     nq, d = q.shape
     deg = graph.shape[1]
     qf = q.astype(jnp.float32)
-    qn = jnp.sum(qf * qf, axis=1)
+    from ..ops.blocked_scan import row_sq_norms
+
+    qn = row_sq_norms(qf)
     # beam scoring takes the RAW query when the 8-bit single-pass tier
     # applies (the f32 cast would silently disable it); one shared
     # eligibility rule keeps this in lockstep with the scorer
@@ -710,8 +712,9 @@ def _search_impl_perop(dataset, graph, routers, router_nodes, q, key,
     nq, d = q.shape
     deg = graph.shape[1]
     qf = q.astype(jnp.float32)
-    qn = jnp.sum(qf * qf, axis=1)
-    from ..ops.blocked_scan import int8_tier_eligible
+    from ..ops.blocked_scan import int8_tier_eligible, row_sq_norms
+
+    qn = row_sq_norms(qf)
 
     q_score = q if int8_tier_eligible(dataset, q, d) else qf
     beam_val, beam_idx = _seed_beam(dataset, routers, router_nodes, q,

@@ -56,6 +56,8 @@ __all__ = [
     "extend",
     "build_sharded",
     "search_sharded",
+    "fleet_slices",
+    "IvfFlatFleetSlices",
 ]
 
 
@@ -454,8 +456,11 @@ def _probe_scan(q, qn, data, ids, counts, norms, probes, k: int, metric: str,
 def _search_impl(centroids, data, ids, counts, norms, q, k: int,
                  n_probes: int, metric: str, keep=None,
                  probe_block: int = 1, scan_kernel: str = "xla"):
+    from ..ops.blocked_scan import row_sq_norms
+
     qf = q.astype(jnp.float32)
-    qn = jnp.sum(qf * qf, axis=1)
+    qn = row_sq_norms(qf)   # dot-contraction: rounds the same in the
+    # fleet's SPMD executable (serve bit-identity, ops.blocked_scan doc)
     cd = sq_l2(q, centroids)                      # [nq, L] MXU block
     _, probes = jax.lax.top_k(-cd, n_probes)      # nearest lists
     bv, bi = _probe_scan(q, qn, data, ids, counts, norms, probes, k, metric,
@@ -817,3 +822,64 @@ def search_sharded(index: IvfFlatIndex, queries, k: int,
     if keep is not None:
         di = sentinel_filtered_ids(dv, di)
     return dv, di
+
+
+@dataclasses.dataclass(frozen=True)
+class IvfFlatFleetSlices:
+    """Device-mesh layout of an IVF-Flat index for the serving fleet
+    (:mod:`raft_tpu.serve.fleet`): the list axis padded to a multiple of
+    the mesh axis and split contiguously — shard *s* owns global lists
+    ``[s*lists_per, (s+1)*lists_per)`` — with the (padded) centroid
+    table replicated so every shard ranks the SAME probe order as the
+    single-device searcher."""
+
+    centroids: jax.Array  # [S*lists_per, d] replicated; pads finite-far
+    data: jax.Array       # [S*lists_per, cap, d] sharded P(axis)
+    ids: jax.Array        # [S*lists_per, cap] sharded; pads -1
+    counts: jax.Array     # [S*lists_per] sharded; pads 0
+    norms: jax.Array      # [S*lists_per, cap] sharded; pads 0
+    lists_per: int        # lists per shard (padded count / S)
+    n_lists: int          # original (unpadded) list count
+
+
+# far-but-finite centroid pad: +inf would reach the probe ranking as
+# 0*inf = NaN through sq_l2's dot-product expansion; 1e15 ranks last in
+# f32 against any real squared distance while staying NaN-free.
+_FLEET_CENTROID_PAD = 1e15
+
+
+def fleet_slices(index: IvfFlatIndex, mesh: Mesh, *,
+                 axis: str = "shard") -> IvfFlatFleetSlices:
+    """Slice an :class:`IvfFlatIndex` over ``mesh[axis]`` for the fleet
+    fan-out.  All padding happens host-side (numpy) and the slabs are
+    ``device_put`` with their target sharding, so the single-device peak
+    is one shard's slice — never the whole index."""
+    from jax.sharding import NamedSharding
+
+    expects(axis in mesh.axis_names, f"axis {axis!r} not in mesh")
+    expects(jnp.issubdtype(jnp.asarray(index.centroids).dtype,
+                           jnp.floating),
+            "fleet slicing needs a float centroid table (the list-axis "
+            "pad is a finite-far float sentinel)")
+    n_dev = int(mesh.shape[axis])
+    L = index.n_lists
+    lp = (L + n_dev - 1) // n_dev
+    pad = lp * n_dev - L
+
+    def _pad0(x, fill):
+        x = np.asarray(x)
+        if not pad:
+            return x
+        shape = (pad,) + x.shape[1:]
+        return np.concatenate([x, np.full(shape, fill, x.dtype)], axis=0)
+
+    cen = _pad0(index.centroids, _FLEET_CENTROID_PAD)
+    rep = NamedSharding(mesh, P())
+    sh = NamedSharding(mesh, P(axis))
+    return IvfFlatFleetSlices(
+        centroids=jax.device_put(jnp.asarray(cen), rep),
+        data=jax.device_put(jnp.asarray(_pad0(index.data, 0)), sh),
+        ids=jax.device_put(jnp.asarray(_pad0(index.ids, -1)), sh),
+        counts=jax.device_put(jnp.asarray(_pad0(index.counts, 0)), sh),
+        norms=jax.device_put(jnp.asarray(_pad0(index.norms, 0)), sh),
+        lists_per=int(lp), n_lists=int(L))

@@ -690,7 +690,7 @@ def _search_recon_impl(centroids, recon, recon_norms, ids, q,
     nq, d = q.shape
     cap = recon.shape[1]
     qf = q.astype(jnp.float32)
-    qn = jnp.sum(qf * qf, axis=1)
+    qn = _scan.row_sq_norms(qf)
     qb = q.astype(jnp.bfloat16)
     cd = sq_l2(q, centroids)                      # [nq, L]
     _, probes = jax.lax.top_k(-cd, n_probes)

@@ -73,6 +73,8 @@ __all__ = [
     "searcher",
     "extend",
     "resolve_rerank_k",
+    "fleet_slices",
+    "IvfRabitqFleetSlices",
 ]
 
 
@@ -528,7 +530,8 @@ def _search_impl(centroids, rotation, codes, sabs, res_norms, code_cdots,
     # dispatch the XLA estimator scan today (gate.py resolves cleanly).
     del scan_kernel
     qf = q.astype(jnp.float32)
-    qn = jnp.sum(qf * qf, axis=1)
+    qn = _scan.row_sq_norms(qf)   # dot-contraction; bit-stable across
+    # the single-device and fleet SPMD executables (serve bit-identity)
     cd = sq_l2(q, centroids)                      # [nq, L] MXU block
     _, probes = jax.lax.top_k(-cd, n_probes)      # nearest lists
     bv, bi = _estimate_scan(q, qf, qn, cd, centroids, rotation, codes,
@@ -724,3 +727,71 @@ def searcher(index: IvfRabitqIndex, k: int,
     return fn, (index.centroids, index.rotation, index.codes, index.sabs,
                 index.res_norms, index.code_cdots, index.data, index.ids,
                 index.counts)
+
+
+@dataclasses.dataclass(frozen=True)
+class IvfRabitqFleetSlices:
+    """Device-mesh layout of an IVF-RaBitQ index for the serving fleet
+    (:mod:`raft_tpu.serve.fleet`): list axis padded to a multiple of the
+    mesh axis and split contiguously (shard *s* owns global lists
+    ``[s*lists_per, (s+1)*lists_per)``); the padded centroid table and
+    the rotation are replicated so every shard quantizes the query and
+    ranks probes identically to the single-device searcher."""
+
+    centroids: jax.Array    # [S*lists_per, d] replicated; pads finite-far
+    rotation: jax.Array     # [d, d] replicated
+    codes: jax.Array        # [S*lists_per, cap, d/8] sharded P(axis)
+    sabs: jax.Array         # [S*lists_per, cap] sharded; pads 0
+    res_norms: jax.Array    # [S*lists_per, cap] sharded; pads 0
+    code_cdots: jax.Array   # [S*lists_per, cap] sharded; pads 0
+    data: jax.Array         # [S*lists_per, cap, d] sharded; pads 0
+    ids: jax.Array          # [S*lists_per, cap] sharded; pads -1
+    counts: jax.Array       # [S*lists_per] sharded; pads 0
+    lists_per: int
+    n_lists: int
+
+
+def fleet_slices(index: IvfRabitqIndex, mesh, *,
+                 axis: str = "shard") -> IvfRabitqFleetSlices:
+    """Slice an :class:`IvfRabitqIndex` over ``mesh[axis]`` for the
+    fleet fan-out.  Padding is host-side numpy and every slab is
+    ``device_put`` with its target sharding (single-device peak = one
+    shard's slice).  The centroid pad is the same far-but-finite
+    sentinel as :func:`ivf_flat.fleet_slices` — +inf turns into NaN
+    through ``sq_l2``'s dot expansion."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .ivf_flat import _FLEET_CENTROID_PAD
+
+    expects(axis in mesh.axis_names, f"axis {axis!r} not in mesh")
+    expects(jnp.issubdtype(jnp.asarray(index.centroids).dtype,
+                           jnp.floating),
+            "fleet slicing needs a float centroid table")
+    n_dev = int(mesh.shape[axis])
+    L = index.n_lists
+    lp = (L + n_dev - 1) // n_dev
+    pad = lp * n_dev - L
+
+    def _pad0(x, fill):
+        x = np.asarray(x)
+        if not pad:
+            return x
+        shape = (pad,) + x.shape[1:]
+        return np.concatenate([x, np.full(shape, fill, x.dtype)], axis=0)
+
+    rep = NamedSharding(mesh, P())
+    sh = NamedSharding(mesh, P(axis))
+    return IvfRabitqFleetSlices(
+        centroids=jax.device_put(
+            jnp.asarray(_pad0(index.centroids, _FLEET_CENTROID_PAD)), rep),
+        rotation=jax.device_put(jnp.asarray(np.asarray(index.rotation)),
+                                rep),
+        codes=jax.device_put(jnp.asarray(_pad0(index.codes, 0)), sh),
+        sabs=jax.device_put(jnp.asarray(_pad0(index.sabs, 0)), sh),
+        res_norms=jax.device_put(jnp.asarray(_pad0(index.res_norms, 0)), sh),
+        code_cdots=jax.device_put(jnp.asarray(_pad0(index.code_cdots, 0)),
+                                  sh),
+        data=jax.device_put(jnp.asarray(_pad0(index.data, 0)), sh),
+        ids=jax.device_put(jnp.asarray(_pad0(index.ids, -1)), sh),
+        counts=jax.device_put(jnp.asarray(_pad0(index.counts, 0)), sh),
+        lists_per=int(lp), n_lists=int(L))

@@ -440,7 +440,7 @@ def _rerank_core(slab, bv, bi, q, k: int, metric: str):
     nq, rk = bi.shape
     flat = slab.reshape(nq * rk, slab.shape[-1])
     qf = q.astype(jnp.float32)
-    qn = jnp.sum(qf * qf, axis=1)
+    qn = _scan.row_sq_norms(qf)
     rescore = _scan.l2_rescorer(flat, None, q, qn, metric)
     ptr = jnp.arange(nq * rk, dtype=jnp.int32).reshape(nq, rk)
     dist = rescore(ptr, bi)

@@ -34,8 +34,10 @@ def _refine_impl(dataset, queries, candidates, k: int, metric: str):
     if metric == "inner_product":
         dist = -dots
     else:
-        vn = jnp.sum(vecs.astype(jnp.float32) ** 2, axis=2)
-        qn = jnp.sum(qf * qf, axis=1)
+        from ..ops.blocked_scan import row_sq_norms
+
+        vn = row_sq_norms(vecs.astype(jnp.float32))
+        qn = row_sq_norms(qf)
         dist = jnp.maximum(vn - 2.0 * dots + qn[:, None], 0.0)
     dist = jnp.where(candidates >= 0, dist, jnp.inf)
     vals, idx = select_k(dist, k, in_idx=candidates, select_min=True)

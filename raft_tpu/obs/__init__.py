@@ -56,7 +56,7 @@ from .drift import DriftDetector
 from .metrics import (DEFAULT_LATENCY_BOUNDARIES_MS, Counter, Gauge,
                       Histogram, MetricRegistry, registry, set_registry)
 from .perfetto import chrome_trace, export_chrome_trace
-from .prometheus import parse_text, render
+from .prometheus import parse_text, render, render_labeled
 from .quality import (QualityConfig, RecallEstimate, RecallEstimator,
                       wilson_interval)
 from .slo import SloEvaluator, SloPolicy
@@ -76,6 +76,7 @@ __all__ = [
     "set_registry",
     "DEFAULT_LATENCY_BOUNDARIES_MS",
     "render",
+    "render_labeled",
     "parse_text",
     "chrome_trace",
     "export_chrome_trace",

@@ -25,7 +25,7 @@ from typing import Dict, Iterable, List, Tuple, Union
 
 from .metrics import Counter, Gauge, Histogram, MetricRegistry
 
-__all__ = ["render", "parse_text"]
+__all__ = ["render", "render_labeled", "parse_text"]
 
 _NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
 
@@ -93,6 +93,58 @@ def render(registries: Union[MetricRegistry,
                     # still exposes 0 so absence is distinguishable from
                     # a scrape miss
                     out.append(f"{name} 0")
+    return "\n".join(out) + "\n"
+
+
+def render_labeled(registries_by_label: Dict[str, MetricRegistry], *,
+                   label: str = "replica") -> str:
+    """One scrape body over many same-shaped registries, disambiguated by
+    an injected label (e.g. the per-replica registries of a fleet, keyed
+    by replica name → every sample gains ``replica="r0"``).
+
+    :func:`render` keeps only the FIRST occurrence of a duplicate family
+    name, so feeding N replica registries through it would silently drop
+    N−1 replicas' series.  Here identical families are expected — they
+    merge under one HELP/TYPE header and each sample carries the
+    distinguishing label, which is exactly the shape
+    ``histogram_quantile()``/``sum by (replica)`` expect fleet-side."""
+    out: List[str] = []
+    headered: set = set()
+    for key in sorted(registries_by_label):
+        reg = registries_by_label[key]
+        inject = {label: str(key)}
+        for metric in reg.collect():
+            name = metric.name
+            if not _NAME_OK.match(name):  # pragma: no cover
+                continue
+            if name not in headered:
+                headered.add(name)
+                if metric.help:
+                    out.append(f"# HELP {name} {_escape(metric.help)}")
+                out.append(f"# TYPE {name} {metric.kind}")
+            if isinstance(metric, Histogram):
+                for labels, counts, total in metric.samples():
+                    labels = {**labels, **inject}
+                    cum = 0
+                    for bound, c in zip(metric.boundaries, counts):
+                        cum += c
+                        le = f'le="{_fmt_value(bound)}"'
+                        out.append(f"{name}_bucket{_fmt_labels(labels, le)}"
+                                   f" {cum}")
+                    cum += counts[-1]
+                    inf_label = 'le="+Inf"'
+                    out.append(f"{name}_bucket"
+                               f"{_fmt_labels(labels, inf_label)} {cum}")
+                    out.append(f"{name}_sum{_fmt_labels(labels)}"
+                               f" {_fmt_value(total)}")
+                    out.append(f"{name}_count{_fmt_labels(labels)} {cum}")
+            elif isinstance(metric, (Counter, Gauge)):
+                samples = metric.samples()
+                for labels, v in samples:
+                    out.append(f"{name}{_fmt_labels({**labels, **inject})}"
+                               f" {_fmt_value(v)}")
+                if not samples:
+                    out.append(f"{name}{_fmt_labels(inject)} 0")
     return "\n".join(out) + "\n"
 
 

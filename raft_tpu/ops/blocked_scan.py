@@ -71,6 +71,7 @@ import jax.numpy as jnp
 __all__ = ["int8_tier_eligible", "exact_gathered_dots", "slab_dots",
            "pack_codes4", "unpack_codes4", "pack_sign_bits",
            "unpack_sign_bits", "packed_sign_dots",
+           "row_sq_norms",
            "fold_topk", "fold_topk_payload", "topk_carry", "ranked_finish",
            "scan_topk", "scan_topk_fused", "list_slab_ptr", "l2_rescorer",
            "resolve_scan_kernel", "scan_kernel_sha"]
@@ -197,6 +198,27 @@ def slab_dots(vecs, q, *, exact: bool = True, packed_sign: bool = False):
         return exact_gathered_dots("qbcd,qbd->qbc", vecs, qb)
     return jnp.einsum("qbcd,qbd->qbc", vecs, qb,
                       preferred_element_type=jnp.float32)
+
+
+def row_sq_norms(qf):
+    """Squared L2 norms over the last axis ``[..., d] → [...]`` as a
+    batched dot contraction, NOT ``jnp.sum(qf * qf, axis=-1)``.
+
+    These norms land in every served distance (``qn + yn − 2·dots``), so
+    the fleet fan-out's bit-identity contract needs them to round the
+    same way in the single-device executable and the shard_map'd SPMD
+    executable.  Elementwise IEEE ops are deterministic per element, and
+    a ``dot_general`` contraction's accumulation order is fixed by its
+    shape — but a mul+``reduce`` lowering's association order is a
+    per-module codegen choice, and the two programs were observed to
+    round query norms one ulp apart on CPU.  Routing every norm that
+    reaches a reported distance through the same dot machinery as the
+    candidate scores pins it."""
+    flat = qf.reshape(-1, qf.shape[-1])
+    out = jax.lax.dot_general(
+        flat, flat, (((1,), (1,)), ((0,), (0,))),
+        precision=jax.lax.Precision.HIGHEST)
+    return out.reshape(qf.shape[:-1])
 
 
 def fold_topk(best_val, best_idx, tile_val, tile_idx, k: int, *,
@@ -355,7 +377,7 @@ def l2_rescorer(data, norms, q, qn, metric: str, *, exact: bool = True,
             return -dots
         if flat_norms is None:  # brute-force order, see docstring
             rf = rows.astype(jnp.float32)
-            yn = jnp.sum(rf * rf, axis=2)
+            yn = row_sq_norms(rf)
             dist = qn[:, None] + yn - 2.0 * dots
         else:
             dist = flat_norms[ptr] - 2.0 * dots + qn[:, None]

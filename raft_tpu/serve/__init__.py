@@ -64,6 +64,10 @@ from .replication import (EpochFence, EpochToken, LogShipper, QueuePair,
                           StandbyReplica)
 from .searchers import family_of, make_searcher, unwrap_tombstones
 from .server import SearchServer, ServerConfig
+from .fleet import (FleetDurability, FleetRouter, FleetServer, LocalReplica,
+                    ReplicaDead, ShardDurability, make_fleet_searcher,
+                    shard_sub_indexes)
+from .placement import Assignment, PlacementPlan, plan_placement
 from ..obs.watchdog import StallWatchdog
 
 __all__ = [
@@ -107,4 +111,15 @@ __all__ = [
     "family_of",
     "make_searcher",
     "unwrap_tombstones",
+    "FleetServer",
+    "FleetRouter",
+    "FleetDurability",
+    "ShardDurability",
+    "LocalReplica",
+    "ReplicaDead",
+    "make_fleet_searcher",
+    "shard_sub_indexes",
+    "Assignment",
+    "PlacementPlan",
+    "plan_placement",
 ]

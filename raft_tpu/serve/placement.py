@@ -1,0 +1,105 @@
+"""Fleet placement policy — where shard primaries and their standbys go.
+
+Pure host logic (no jax): the fleet's durability story is only as good
+as its placement — a standby on its primary's host dies with it.  The
+planner here implements the anti-affinity rule every replicated store
+uses (HDFS rack-awareness, Cassandra NetworkTopologyStrategy): a shard's
+follower NEVER lands on the host serving that shard's primary, and load
+spreads round-robin so no host carries a disproportionate share of
+either role.
+
+:class:`PlacementPlan` is a frozen value object — the fleet bootstrap
+computes it once, tests assert on it directly, and the runbook prints it
+(``describe()``) so an operator can audit the topology before traffic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Sequence, Tuple
+
+from ..core.errors import expects
+
+__all__ = ["Assignment", "PlacementPlan", "plan_placement"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Assignment:
+    """One shard's durability placement: the host that owns the primary
+    ``DurableStore`` and the hosts holding its warm standbys."""
+
+    shard: int
+    primary: str
+    standbys: Tuple[str, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class PlacementPlan:
+    """The fleet's full shard→host assignment."""
+
+    hosts: Tuple[str, ...]
+    assignments: Tuple[Assignment, ...]
+
+    def primaries_on(self, host: str) -> List[int]:
+        return [a.shard for a in self.assignments if a.primary == host]
+
+    def standbys_on(self, host: str) -> List[int]:
+        return [a.shard for a in self.assignments if host in a.standbys]
+
+    def validate(self) -> None:
+        """Re-check the anti-affinity invariant (tests + startup gate)."""
+        for a in self.assignments:
+            expects(a.primary not in a.standbys,
+                    f"shard {a.shard}: standby co-located with its "
+                    f"primary on {a.primary!r}")
+            expects(len(set(a.standbys)) == len(a.standbys),
+                    f"shard {a.shard}: duplicate standby host")
+
+    def describe(self) -> str:
+        """Operator-facing table (the runbook prints this before the
+        fleet takes traffic)."""
+        lines = [f"{len(self.assignments)} shards over "
+                 f"{len(self.hosts)} hosts"]
+        for a in self.assignments:
+            feet = ", ".join(a.standbys) if a.standbys else "-"
+            lines.append(f"  shard {a.shard}: primary={a.primary} "
+                         f"standbys=[{feet}]")
+        return "\n".join(lines)
+
+
+def plan_placement(n_shards: int, hosts: Sequence[str], *,
+                   n_standbys: int = 1) -> PlacementPlan:
+    """Assign each shard a primary host and ``n_standbys`` follower
+    hosts under anti-affinity.
+
+    Primaries round-robin over ``hosts`` (shard *i* → host ``i % H``);
+    each standby then takes the least-loaded host that is neither the
+    shard's primary nor one of its earlier standbys — ties break by host
+    order, so the plan is deterministic.  Requires
+    ``n_standbys < len(hosts)``: with H hosts at most H−1 distinct
+    non-primary homes exist per shard.
+    """
+    hosts = tuple(str(h) for h in hosts)
+    expects(len(hosts) >= 1, "placement needs at least one host")
+    expects(len(set(hosts)) == len(hosts), "duplicate host names")
+    expects(n_shards >= 1, "placement needs at least one shard")
+    expects(0 <= n_standbys < max(len(hosts), 1) or n_standbys == 0,
+            f"{n_standbys} standbys need at least {n_standbys + 1} "
+            f"distinct hosts, have {len(hosts)}")
+    load: Dict[str, int] = {h: 0 for h in hosts}  # standby count per host
+    assignments: List[Assignment] = []
+    for s in range(int(n_shards)):
+        primary = hosts[s % len(hosts)]
+        standbys: List[str] = []
+        for _ in range(int(n_standbys)):
+            candidates = [h for h in hosts
+                          if h != primary and h not in standbys]
+            # least standby load first, then host order: deterministic
+            chosen = min(candidates, key=lambda h: (load[h],
+                                                    hosts.index(h)))
+            load[chosen] += 1
+            standbys.append(chosen)
+        assignments.append(Assignment(s, primary, tuple(standbys)))
+    plan = PlacementPlan(hosts, tuple(assignments))
+    plan.validate()
+    return plan

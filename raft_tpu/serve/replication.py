@@ -432,14 +432,28 @@ class LogShipper:
     consumed by :meth:`pump`, either manually (deterministic tests) or
     from :meth:`start`'s background thread.  Follower watermarks live on
     the store itself (``register_follower`` / ``follower_acked``) so
-    ``DurableStore.prune_wal`` sees them without knowing this class."""
+    ``DurableStore.prune_wal`` sees them without knowing this class.
+
+    ``transport`` is one endpoint or a sequence of them — one per
+    follower (the fleet tier places several anti-affinity standbys per
+    shard).  Records and heartbeats broadcast to every link; catch-up
+    replies go back on the link the ``hello`` arrived on; the semi-sync
+    ack wait and the async loss bound both measure against
+    ``DurableStore.follower_floor()`` — the SLOWEST follower — so the
+    durability guarantee is fleet-wide, not per-link.  Per-follower lag
+    is exported as ``raft_replication_follower_lag_lsn{follower=...}``
+    next to the floor-level ``raft_replication_lag_*`` pair."""
 
     def __init__(self, store: DurableStore, transport, *,
                  config: Optional[ReplicationConfig] = None,
                  node_id: str = "primary", registry=None, faults=None,
                  clock=time.monotonic) -> None:
         self.store = store
-        self.transport = transport
+        if isinstance(transport, (list, tuple)):
+            expects(len(transport) >= 1, "LogShipper needs >= 1 transport")
+            self.transports: List[Any] = list(transport)
+        else:
+            self.transports = [transport]
         self.config = config or ReplicationConfig()
         expects(self.config.ack_mode in _ACK_MODES,
                 f"unknown ack_mode {self.config.ack_mode!r} ({_ACK_MODES})")
@@ -470,6 +484,9 @@ class LogShipper:
             "raft_replication_lag_seconds",
             "seconds since the slowest follower's last ack "
             "(primary clock)")
+        self._follower_lag = reg.gauge(
+            "raft_replication_follower_lag_lsn",
+            "primary WAL lsn minus one follower's acked lsn")
         fence = getattr(store, "fence", None)
         self.fence = fence if fence is not None \
             else EpochFence.load(store.root, self.node_id, writer=True)
@@ -481,6 +498,7 @@ class LogShipper:
             # so shipping starts by claiming epoch 1
             self.fence.advance()
         self._ack_t: Dict[str, float] = {}  # follower -> clock at last ack
+        self._follower_link: Dict[str, Any] = {}  # follower -> hello's link
         self._cond = threading.Condition()
         self._last_beat = float("-inf")
         self._stop = threading.Event()
@@ -489,7 +507,27 @@ class LogShipper:
 
     # -- outbound ------------------------------------------------------
 
-    def _send(self, blob: bytes, *, what: str) -> bool:
+    @property
+    def transport(self):
+        """The first (historically only) follower link — kept for the
+        single-follower call sites; multi-follower code iterates
+        ``transports``."""
+        return self.transports[0]
+
+    @transport.setter
+    def transport(self, value) -> None:
+        """Replace the sole follower link (restart-with-new-socket path).
+        Stale per-follower reply links die with the old endpoint; the
+        follower's next hello re-registers over the new one."""
+        expects(len(self.transports) == 1,
+                "transport setter is single-follower only; "
+                "mutate `transports` for a fan-out shipper")
+        self.transports = [value]
+        self._follower_link.clear()
+
+    def _send(self, blob: bytes, *, what: str, transport=None) -> bool:
+        """Send on one link (``transport``) or broadcast to every
+        follower link; True when at least one delivery succeeded."""
         if self.faults is not None:
             try:
                 self.faults.fire("ship_send")
@@ -498,14 +536,18 @@ class LogShipper:
                 obs_spans.recorder().event("replication.drop",
                                            site="ship_send", what=what)
                 return False
-        try:
-            self.transport.send(blob)
-        except OSError as exc:
-            self._drops.inc()
-            obs_spans.recorder().event("replication.drop", site="ship_send",
-                                       what=what, error=type(exc).__name__)
-            return False
-        return True
+        links = self.transports if transport is None else [transport]
+        ok = False
+        for link in links:
+            try:
+                link.send(blob)
+                ok = True
+            except OSError as exc:
+                self._drops.inc()
+                obs_spans.recorder().event("replication.drop",
+                                           site="ship_send", what=what,
+                                           error=type(exc).__name__)
+        return ok
 
     def _record_blob(self, lsn: int, op: str, arrays, static) -> bytes:
         return encode_message("record", arrays, lsn=int(lsn), op=str(op),
@@ -563,18 +605,23 @@ class LogShipper:
     # -- inbound -------------------------------------------------------
 
     def pump(self, timeout: float = 0.0) -> int:
-        """Process pending follower traffic; returns messages handled."""
+        """Process pending follower traffic (every link); returns
+        messages handled.  The blocking ``timeout`` applies to the first
+        link only — subsequent links drain whatever is already pending,
+        so a silent follower never starves the others."""
         n = 0
         t = timeout
-        while True:
-            msg = self.transport.recv(t)
-            if msg is None:
-                return n
-            self._handle(msg)
-            n += 1
-            t = 0.0
+        for link in list(self.transports):
+            while True:
+                msg = link.recv(t)
+                t = 0.0
+                if msg is None:
+                    break
+                self._handle(msg, link)
+                n += 1
+        return n
 
-    def _handle(self, msg: Message) -> None:
+    def _handle(self, msg: Message, transport=None) -> None:
         s = msg.static
         if "epoch" in s and self.fence.observe(s.get("epoch", 0),
                                                s.get("node", "")):
@@ -586,12 +633,17 @@ class LogShipper:
             ack = int(s["ack_lsn"])
             self.store.register_follower(fid, ack)
             self._ack_t[fid] = self.clock()
-            self._catch_up(fid, ack, cold=bool(s.get("cold")))
+            if transport is not None:
+                self._follower_link[fid] = transport
+            self._catch_up(fid, ack, cold=bool(s.get("cold")),
+                           transport=transport)
         elif msg.kind == "ack":
             fid = str(s["node"])
             self.store.follower_acked(fid, int(s["lsn"]))
             self._acks.inc()
             self._ack_t[fid] = self.clock()
+            if transport is not None:
+                self._follower_link.setdefault(fid, transport)
             self._update_lag()
             with self._cond:
                 self._cond.notify_all()
@@ -601,7 +653,11 @@ class LogShipper:
         floor = self.store.follower_floor()
         if floor is None:
             return
-        lag = max(0, self.store.wal_lsn - floor)
+        lsn = self.store.wal_lsn
+        for fid, acked in self.store.followers().items():
+            self._follower_lag.set(float(max(0, lsn - acked)),
+                                   follower=fid)
+        lag = max(0, lsn - floor)
         self._lag_lsn.set(float(lag))
         if lag == 0 or not self._ack_t:
             self._lag_s.set(0.0)
@@ -611,7 +667,11 @@ class LogShipper:
 
     # -- catch-up ------------------------------------------------------
 
-    def _catch_up(self, fid: str, from_lsn: int, cold: bool) -> None:
+    def _catch_up(self, fid: str, from_lsn: int, cold: bool,
+                  transport=None) -> None:
+        # replies ride the link the hello arrived on: a broadcast resync
+        # would re-deliver (harmless duplicates, re-acked) but waste the
+        # other followers' bandwidth on records they already hold
         rec = obs_spans.recorder()
         with rec.span("replication.catch_up", follower=fid,
                       from_lsn=from_lsn, cold=cold):
@@ -624,17 +684,18 @@ class LogShipper:
             if cold or from_lsn < base:
                 # the tail alone cannot reach the follower's watermark:
                 # bootstrap from the newest published snapshot
-                watermark = self._ship_snapshot()
+                watermark = self._ship_snapshot(transport)
                 from_lsn = max(from_lsn, watermark)
             for r in records:
                 if r.lsn > from_lsn:
                     if not self._send(self._record_blob(r.lsn, r.op,
                                                         r.arrays, r.static),
-                                      what=f"catchup:{r.lsn}"):
+                                      what=f"catchup:{r.lsn}",
+                                      transport=transport):
                         break  # partitioned: the follower will re-hello
             self.beat(force=True)
 
-    def _ship_snapshot(self) -> int:
+    def _ship_snapshot(self, transport=None) -> int:
         snaps = self.store.snapshots()
         if not snaps:
             self.store.snapshot()
@@ -654,7 +715,7 @@ class LogShipper:
                                   watermark=watermark, files=files,
                                   node=self.node_id,
                                   epoch=self.fence.epoch, t=self.clock()),
-                   what=f"snapshot:{name}")
+                   what=f"snapshot:{name}", transport=transport)
         return watermark
 
     # -- lifecycle -----------------------------------------------------

@@ -452,14 +452,40 @@ class SearchServer:
             if hit is not None:
                 return hit
         scale = self.config.degrade_effort_scales[level]
-        fn, operands = make_searcher(gen.index, k, self.params,
-                                     effort_scale=scale, seed=self.seed)
+        fn, operands = self._make_parts(gen.index, k, scale)
         with self._parts_lock:
             current = self._registry.gen_id
             for old in [kk for kk in self._searchers if kk[0] < current]:
                 del self._searchers[old]
             self._searchers.setdefault(key, (fn, operands))
             return self._searchers[key]
+
+    def _make_parts(self, index, k: int, scale: float):
+        """Searcher-factory seam: build the ``(fn, operands)`` pair for
+        one effort scale.  The fleet tier's per-replica servers override
+        this with :func:`raft_tpu.serve.fleet.make_fleet_searcher` (the
+        mesh-sharded fan-out) — everything else about dispatch (buckets,
+        cache, admission, degradation) is topology-agnostic."""
+        return make_searcher(index, k, self.params, effort_scale=scale,
+                             seed=self.seed)
+
+    def _stage_queries(self, qpad):
+        """Host→device transfer seam for the padded query batch; fleet
+        servers override to place the batch replicated over their mesh
+        (an AOT executable's input sharding is part of its signature)."""
+        return jax.device_put(qpad)
+
+    def _query_spec(self, bucket: int, dtype):
+        """The AOT lowering spec for one query bucket; fleet servers
+        attach the replicated mesh sharding here so the compiled
+        executable and :meth:`_stage_queries` agree."""
+        return jax.ShapeDtypeStruct((bucket, self._dim), dtype)
+
+    def queue_depth(self) -> int:
+        """Requests waiting in the queue (lock-guarded read) — the
+        router's load signal."""
+        with self._cond:
+            return len(self._pending)
 
     @staticmethod
     def _operand_scope(operands):
@@ -475,8 +501,7 @@ class SearchServer:
                int(level), self._operand_scope(operands))
 
         def build():
-            spec = jax.ShapeDtypeStruct((bucket, self._dim), dtype)
-            return fn, operands, spec
+            return fn, operands, self._query_spec(bucket, dtype)
 
         return self.cache.get(key, build), operands
 
@@ -514,7 +539,7 @@ class SearchServer:
                         # ``jax.transfer_guard("disallow")``, so a
                         # TraceGuard-wrapped serve loop proves these are the
                         # ONLY host<->device crossings on the path
-                        d, i = compiled(jax.device_put(qpad), *operands)
+                        d, i = compiled(self._stage_queries(qpad), *operands)
                         d, i = jax.device_get((d, i))  # host fetch = completion barrier
                         d = np.asarray(d)
                         i = np.asarray(i)

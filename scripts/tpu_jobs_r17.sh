@@ -1,0 +1,155 @@
+#!/bin/bash
+# Round-17 TPU job queue: first hardware round for the pod-scale
+# serving fleet (raft_tpu.serve.fleet — ISSUE 16).
+#   * mosaic re-stamps bench/MOSAIC_CHECK.json first, as always — the
+#     dispatch gate rejects stale kernel_sha stamps.
+#   * fleet_smoke — the fan-out contract where the mesh is real: the
+#     comms selftest battery must pass on the hardware collectives, and
+#     the shard_map fan-out (brute + ivf_flat + ivf_rabitq) must be
+#     bit-identical — values AND ids — to the single-device searcher at
+#     the full device width, THROUGH FleetServer's router, including a
+#     replica-kill shed and a lease-expiry promote sweep.
+#   * fleet_bench — the multi-process closed-loop driver
+#     (RAFT_BENCH_SERVE_REPLICAS): replica workers are host processes
+#     here exactly as on CPU (one accelerator host = one replica in a
+#     real pod; a TPU chip cannot be shared across processes), so the
+#     step runs --cpu on the host and the harvested final line becomes
+#     FLEET_TPUHOST.json next round.  The CPU curve is committed as
+#     bench/FLEET_CPU.json.
+# Stage order: jaxlint -> mosaic -> fleet smoke -> fleet bench ->
+# bench.py.
+# Markers stay in /tmp/tpu_jobs_r3 so steps completed by earlier rounds'
+# queues are not repeated.
+set -u
+cd /root/repo || exit 1
+LOG=/tmp/tpu_jobs_r3
+mkdir -p "$LOG"
+. "$(dirname "$0")/tpu_queue_lib.sh"
+acquire_queue_lock tpu_jobs_r17
+export RAFT_BENCH_CKPT_DIR="$LOG/bench_ckpt"
+
+echo "$(date) [r17 queue] waiting for TPU..." >> "$LOG/driver.log"
+wait_probe
+echo "$(date) TPU is back" >> "$LOG/driver.log"
+
+run_step() {  # name, timeout_s, command...   (two attempts, gated .done)
+  local name=$1 tmo=$2; shift 2
+  [ -f "$LOG/$name.done" ] && return 0
+  local attempt
+  for attempt in 1 2; do
+    echo "$(date) start $name (attempt $attempt)" >> "$LOG/driver.log"
+    timeout "$tmo" "$@" > "$LOG/$name.$attempt.log" 2>&1 9<&-
+    rc=$?
+    cp -f "$LOG/$name.$attempt.log" "$LOG/$name.log"  # latest = canonical
+    if [ "$rc" -eq 0 ]; then
+      if [ "$name" != bench ] || bench_measured "$LOG/$name.log" brute_force; then
+        touch "$LOG/$name.done"
+        echo "$(date) done $name" >> "$LOG/driver.log"
+        return 0
+      fi
+      echo "$(date) $name exited 0 with no headline measurement (wedged backend)" \
+        >> "$LOG/driver.log"
+    else
+      echo "$(date) FAILED $name (rc=$rc)" >> "$LOG/driver.log"
+    fi
+    # a killed/wedged client can poison the tunnel for the next step too:
+    # re-probe before the retry (or before handing on to the next step)
+    wait_probe
+  done
+}
+
+# jaxlint first: pure-host AST pass over the new fleet/placement modules
+# (the fan-out itself is shard_map over existing kernels — zero new
+# device entry points to waive), zero chip time
+run_step jaxlint_r17    300 python scripts/mini_lint.py --jax raft_tpu --stats-json bench/JAXLINT.json
+# mosaic BEFORE anything that dispatches Pallas: re-validates the kernels
+# on hardware and stamps the sha-scoped artifact the dispatch gate needs
+run_step mosaic         900 env RAFT_MOSAIC_REQUIRE_TPU=1 python scripts/mosaic_check.py
+# the fan-out contract on real collectives (written to a file first:
+# run_step retries must not re-read stdin)
+cat > "$LOG/fleet_smoke.py" <<'PY'
+import json, os, sys, tempfile
+
+sys.path.insert(0, os.getcwd())        # the queue runs this from /root/repo
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from raft_tpu.comms import Comms, verify_comms
+from raft_tpu.neighbors import brute_force, ivf_flat, ivf_rabitq, mutation
+from raft_tpu.serve import (FleetServer, ReplicationConfig, ServerConfig,
+                            make_fleet_searcher, make_searcher)
+
+devs = jax.devices()
+assert len(devs) >= 2, devs
+mesh = Mesh(np.asarray(devs), ("shard",))
+selftest = verify_comms(Comms(mesh, "shard"))
+assert selftest and all(selftest.values()), selftest
+
+rng = np.random.default_rng(42)
+db = rng.standard_normal((4096, 64)).astype(np.float32)
+q = (1.3 * rng.standard_normal((16, 64))).astype(np.float32)
+K = 10
+
+def check(tag, index, params, **kw):
+    fn, ops = make_fleet_searcher(index, K, params, mesh=mesh, **kw)
+    rfn, rops = make_searcher(index, K, params, **kw)
+    d, i = fn(q, *ops)
+    rd, ri = rfn(q, *rops)
+    np.testing.assert_array_equal(np.asarray(jax.device_get(d)),
+                                  np.asarray(jax.device_get(rd)))
+    np.testing.assert_array_equal(np.asarray(jax.device_get(i)),
+                                  np.asarray(jax.device_get(ri)))
+    return tag
+
+flat = ivf_flat.build(db, ivf_flat.IvfFlatIndexParams(n_lists=32, seed=0))
+checked = [
+    check("brute", db, None),
+    check("ivf_flat", flat, ivf_flat.IvfFlatSearchParams(n_probes=8)),
+    check("ivf_rabitq",
+          ivf_rabitq.build(db, ivf_rabitq.IvfRabitqIndexParams(n_lists=32)),
+          ivf_rabitq.IvfRabitqSearchParams(n_probes=8, rerank_k=32)),
+    check("tombstoned", mutation.delete(flat, np.arange(40)),
+          ivf_flat.IvfFlatSearchParams(n_probes=8)),
+]
+
+# the full server: routed search == direct search, shed on kill, promote
+fleet = FleetServer(flat, k=K,
+                    params=ivf_flat.IvfFlatSearchParams(n_probes=8),
+                    mesh=mesh, n_replicas=2,
+                    config=ServerConfig(ladder=(16,)))
+rd, ri = ivf_flat.search(flat, q, K,
+                         ivf_flat.IvfFlatSearchParams(n_probes=8))
+d, i = fleet.search(q)
+np.testing.assert_array_equal(np.asarray(jax.device_get(i)),
+                              np.asarray(jax.device_get(ri)))
+fleet.kill_replica("r0")
+d, i = fleet.search(q)
+np.testing.assert_array_equal(np.asarray(jax.device_get(i)),
+                              np.asarray(jax.device_get(ri)))
+dur = fleet.attach_durability(
+    tempfile.mkdtemp(prefix="raft-fleet-smoke-"),
+    ["hostA", "hostB", "hostC"], n_standbys=2,
+    config=ReplicationConfig(ack_mode="async", lease_s=3.0))
+dur.pump()
+promoted = fleet.promote_expired(fleet.replicas[0].server.clock() + 100.0)
+assert promoted == list(range(fleet.n_shards)), promoted
+fleet.stop()
+print(json.dumps({"config": "fleet_smoke",
+                  "backend": jax.default_backend(),
+                  "mesh_width": len(devs), "bitwise": checked,
+                  "selftest": sorted(selftest),
+                  "promoted_shards": promoted}))
+PY
+run_step fleet_smoke    1800 python "$LOG/fleet_smoke.py"
+# the replica-scaling ratchet: subprocess workers on the host CPU (one
+# process per replica — the topology a real pod runs per host); the
+# final line is harvested into FLEET_TPUHOST.json next round
+run_step fleet_bench    1800 env RAFT_BENCH_SERVE_ROWS=2000 \
+  RAFT_BENCH_SERVE_DIM=32 RAFT_BENCH_SERVE_K=8 \
+  RAFT_BENCH_SERVE_LADDER=1,8 RAFT_BENCH_SERVE_FLEET_WAIT_MS=25 \
+  RAFT_BENCH_SERVE_FLEET_CLIENTS=4 RAFT_BENCH_SERVE_SECONDS=6 \
+  RAFT_BENCH_SERVE_REPLICAS=1,2,4 python bench/serve.py --cpu
+run_step bench         4500 python bench.py
+echo "$(date) all steps attempted" >> "$LOG/driver.log"

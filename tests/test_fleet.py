@@ -1,0 +1,373 @@
+"""Pod-scale serving fleet tests (ISSUE 16).
+
+The acceptance criteria these pin:
+
+* the sharded fan-out searcher is **bit-identical** — values AND ids —
+  to the single-device :func:`serve.make_searcher` reference at mesh
+  widths 2, 4 and 8, for every fleet-enabled family (brute_force exact,
+  ivf_flat, ivf_rabitq) and both metric families, including a
+  Tombstoned/filtered query routed through the fan-out;
+* :func:`plan_placement` enforces anti-affinity — a shard's standby
+  never lands on its primary's host — with deterministic round-robin
+  load spread;
+* :func:`init_distributed` rejects an ``axis_shape`` that does not
+  cover the visible devices, and ``FleetServer`` refuses to serve when
+  the comms selftest battery fails (broken-collective startup gate);
+* the replica group serves through the router bit-identically to a
+  direct index ``search()``, sheds from a killed replica to survivors,
+  and exposes per-replica metrics under an injected ``replica`` label;
+* :class:`FleetDurability` gives every shard a primary store + WAL
+  shipped to anti-affinity standbys, and ``promote_expired`` fails over
+  on lease expiry.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from raft_tpu.comms import Comms, init_distributed, verify_comms
+from raft_tpu.neighbors import brute_force, ivf_flat, ivf_rabitq, mutation
+from raft_tpu.serve import (FleetRouter, FleetServer, QueueFull, ReplicaDead,
+                            ReplicationConfig, ServerConfig,
+                            make_fleet_searcher, make_searcher,
+                            plan_placement, shard_sub_indexes)
+from raft_tpu.serve.searchers import BruteForceSearchParams
+
+pytestmark = pytest.mark.usefixtures("devices")
+
+K = 7
+WIDTHS = (2, 4, 8)
+
+
+def _mesh(devices, width: int) -> Mesh:
+    return Mesh(np.asarray(devices[:width]), ("shard",))
+
+
+def _eq(got, want):
+    dv, iv = got
+    rv, ri = want
+    np.testing.assert_array_equal(np.asarray(jax.device_get(dv)),
+                                  np.asarray(jax.device_get(rv)))
+    np.testing.assert_array_equal(np.asarray(jax.device_get(iv)),
+                                  np.asarray(jax.device_get(ri)))
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(42)
+    db = rng.standard_normal((600, 32)).astype(np.float32)
+    # queries off the db manifold so no distance ties hide an id swap
+    q = (1.3 * rng.standard_normal((9, 32))).astype(np.float32)
+    return db, q
+
+
+@pytest.fixture(scope="module")
+def flat_index(data):
+    db, _ = data
+    return ivf_flat.build(db, ivf_flat.IvfFlatIndexParams(n_lists=13))
+
+
+@pytest.fixture(scope="module")
+def rabitq_index(data):
+    db, _ = data
+    return ivf_rabitq.build(db, ivf_rabitq.IvfRabitqIndexParams(n_lists=13))
+
+
+# ---------------------------------------------------------------------------
+# bit-identity across mesh widths — the fan-out contract
+
+
+@pytest.mark.parametrize("width", WIDTHS)
+def test_brute_fanout_bit_identity(data, devices, width):
+    db, q = data
+    db = db[:257]  # odd row count: pad lanes exercised on every width
+    p = BruteForceSearchParams(tile=64)
+    fn, ops = make_fleet_searcher(db, K, p, mesh=_mesh(devices, width))
+    rfn, rops = make_searcher(db, K, p)
+    _eq(fn(q, *ops), rfn(q, *rops))
+
+
+def test_brute_fanout_inner_product(data, devices):
+    db, q = data
+    p = BruteForceSearchParams(metric="inner_product")
+    fn, ops = make_fleet_searcher(db[:200], K, p, mesh=_mesh(devices, 4))
+    rfn, rops = make_searcher(db[:200], K, p)
+    _eq(fn(q, *ops), rfn(q, *rops))
+
+
+@pytest.mark.parametrize("width", WIDTHS)
+def test_ivf_flat_fanout_bit_identity(data, flat_index, devices, width):
+    _, q = data
+    p = ivf_flat.IvfFlatSearchParams(n_probes=5)
+    fn, ops = make_fleet_searcher(flat_index, K, p,
+                                  mesh=_mesh(devices, width))
+    rfn, rops = make_searcher(flat_index, K, p)
+    _eq(fn(q, *ops), rfn(q, *rops))
+
+
+def test_ivf_flat_fanout_inner_product(data, devices):
+    db, q = data
+    idx = ivf_flat.build(db, ivf_flat.IvfFlatIndexParams(
+        n_lists=13, metric="inner_product"))
+    p = ivf_flat.IvfFlatSearchParams(n_probes=5)
+    fn, ops = make_fleet_searcher(idx, K, p, mesh=_mesh(devices, 4))
+    rfn, rops = make_searcher(idx, K, p)
+    _eq(fn(q, *ops), rfn(q, *rops))
+
+
+@pytest.mark.parametrize("width", WIDTHS)
+def test_ivf_rabitq_fanout_bit_identity(data, rabitq_index, devices, width):
+    _, q = data
+    p = ivf_rabitq.IvfRabitqSearchParams(n_probes=5, rerank_k=24)
+    fn, ops = make_fleet_searcher(rabitq_index, K, p,
+                                  mesh=_mesh(devices, width))
+    rfn, rops = make_searcher(rabitq_index, K, p)
+    _eq(fn(q, *ops), rfn(q, *rops))
+
+
+def test_tombstoned_query_through_fanout(data, flat_index, devices):
+    """A deleted-rows view serves through the fan-out exactly as through
+    the single-device searcher, and deleted ids never surface."""
+    _, q = data
+    dead = np.arange(0, 51)
+    view = mutation.delete(flat_index, dead)
+    p = ivf_flat.IvfFlatSearchParams(n_probes=5)
+    fn, ops = make_fleet_searcher(view, K, p, mesh=_mesh(devices, 4))
+    rfn, rops = make_searcher(view, K, p)
+    got = fn(q, *ops)
+    _eq(got, rfn(q, *rops))
+    ids = np.asarray(jax.device_get(got[1]))
+    assert not np.isin(ids[ids >= 0], dead).any()
+
+
+def test_explicit_filter_ands_with_tombstones(data, flat_index, devices):
+    _, q = data
+    view = mutation.delete(flat_index, np.arange(0, 20))
+    keep = np.ones(600, bool)
+    keep[300:] = False
+    p = ivf_flat.IvfFlatSearchParams(n_probes=5)
+    fn, ops = make_fleet_searcher(view, K, p, mesh=_mesh(devices, 2),
+                                  filter=keep)
+    rfn, rops = make_searcher(view, K, p, filter=keep)
+    got = fn(q, *ops)
+    _eq(got, rfn(q, *rops))
+    ids = np.asarray(jax.device_get(got[1]))
+    live = ids[ids >= 0]
+    assert (live >= 20).all() and (live < 300).all()
+
+
+def test_effort_scale_parity_with_single_device(data, flat_index, devices):
+    """Degraded tiers shard identically: the fleet at effort 0.5 matches
+    the single-device searcher at effort 0.5 (fewer probes, same fold)."""
+    _, q = data
+    p = ivf_flat.IvfFlatSearchParams(n_probes=8)
+    fn, ops = make_fleet_searcher(flat_index, K, p, mesh=_mesh(devices, 2),
+                                  effort_scale=0.5)
+    rfn, rops = make_searcher(flat_index, K, p, effort_scale=0.5)
+    _eq(fn(q, *ops), rfn(q, *rops))
+
+
+def test_fleet_rejects_unpinnable_modes(data, devices):
+    db, _ = data
+    mesh = _mesh(devices, 2)
+    with pytest.raises(Exception, match="exact mode only"):
+        make_fleet_searcher(db, K, BruteForceSearchParams(mode="fast"),
+                            mesh=mesh)
+    with pytest.raises(Exception, match="effort_scale"):
+        make_fleet_searcher(db, K, None, mesh=mesh, effort_scale=1.5)
+    with pytest.raises(Exception, match="axis"):
+        make_fleet_searcher(db, K, None, mesh=mesh, axis="replica")
+
+
+# ---------------------------------------------------------------------------
+# placement — anti-affinity policy
+
+
+def test_placement_anti_affinity_and_round_robin():
+    plan = plan_placement(4, ["a", "b", "c"], n_standbys=2)
+    plan.validate()
+    assert [a.primary for a in plan.assignments] == ["a", "b", "c", "a"]
+    for a in plan.assignments:
+        assert a.primary not in a.standbys
+        assert len(set(a.standbys)) == 2
+    # standby load spreads: no host hoards followers
+    counts = [len(plan.standbys_on(h)) for h in plan.hosts]
+    assert max(counts) - min(counts) <= 1
+    assert plan.primaries_on("a") == [0, 3]
+    # deterministic: same inputs, same plan
+    assert plan == plan_placement(4, ["a", "b", "c"], n_standbys=2)
+
+
+def test_placement_rejects_impossible_topologies():
+    with pytest.raises(Exception):
+        plan_placement(2, ["a"], n_standbys=1)  # nowhere anti-affine
+    with pytest.raises(Exception):
+        plan_placement(2, ["a", "a"], n_standbys=1)  # duplicate host
+    with pytest.raises(Exception):
+        plan_placement(0, ["a"])  # no shards
+
+
+# ---------------------------------------------------------------------------
+# bootstrap validation + the broken-collective startup gate
+
+
+def test_init_distributed_rejects_partial_device_cover():
+    with pytest.raises(ValueError, match="must use every visible device"):
+        init_distributed(axis_shape=(3,))
+    with pytest.raises(Exception, match="axis_shape"):
+        init_distributed(axis_shape=(2, 4))  # one axis name, two dims
+    comms = init_distributed(axis_shape=(len(jax.devices()),))
+    assert comms.mesh.devices.size == len(jax.devices())
+
+
+def test_verify_comms_passes_on_healthy_mesh(devices):
+    results = verify_comms(Comms(_mesh(devices, 2), "shard"))
+    assert results and all(results.values())
+
+
+def test_fleet_server_refuses_broken_collective(data, devices, monkeypatch):
+    from raft_tpu.comms import selftest
+
+    db, _ = data
+    monkeypatch.setattr(selftest, "run_all",
+                        lambda comms: {"allgather": False, "allreduce": True})
+    with pytest.raises(RuntimeError, match="refusing to serve"):
+        FleetServer(db[:64], k=3, mesh=_mesh(devices, 2))
+
+
+# ---------------------------------------------------------------------------
+# router — duck-typed fakes (no jax in the loop)
+
+
+class _FakeReplica:
+    def __init__(self, name, depth=0, fail=None):
+        self.name, self.alive, self.depth, self.fail = name, True, depth, fail
+        self.served = 0
+
+    def load(self):
+        return self.depth
+
+    def search(self, queries, k=None, deadline_ms=None):
+        if not self.alive:
+            raise ReplicaDead(self.name)
+        if self.fail is not None:
+            raise self.fail
+        self.served += 1
+        return ("d", self.name)
+
+
+def test_router_prefers_least_loaded():
+    a, b = _FakeReplica("a", depth=5), _FakeReplica("b", depth=0)
+    r = FleetRouter([a, b])
+    assert r.search(None)[1] == "b"
+
+
+def test_router_spills_queue_full_to_peer():
+    a = _FakeReplica("a", depth=0, fail=QueueFull("full"))
+    b = _FakeReplica("b", depth=9)
+    r = FleetRouter([a, b])
+    assert r.search(None)[1] == "b"  # spilled off the saturated favorite
+
+
+def test_router_sheds_dead_replica_and_raises_when_none_left():
+    a, b = _FakeReplica("a"), _FakeReplica("b", depth=3)
+    a.alive = False
+    r = FleetRouter([a, b])
+    assert r.search(None)[1] == "b"
+    assert [x.name for x in r.live()] == ["b"]
+    b.alive = False
+    with pytest.raises(ReplicaDead):
+        r.search(None)
+
+
+# ---------------------------------------------------------------------------
+# the fleet server end-to-end (manual drive — no dispatch threads)
+
+
+def test_fleet_server_end_to_end(data, flat_index, devices, tmp_path):
+    db, q = data
+    mesh = _mesh(devices, 4)
+    p = ivf_flat.IvfFlatSearchParams(n_probes=5)
+    fleet = FleetServer(flat_index, k=K, params=p, mesh=mesh,
+                        n_replicas=2, selftest=False,
+                        config=ServerConfig(ladder=(16,)))
+    assert fleet.n_shards == 4
+
+    # routed search == direct index search, values AND ids
+    d_ref, i_ref = ivf_flat.search(flat_index, q, K, p)
+    _eq(fleet.search(q), (d_ref, i_ref))
+
+    # kill drill: router sheds to the survivor, results unchanged
+    fleet.kill_replica("r0")
+    assert [r.name for r in fleet.router.live()] == ["r1"]
+    _eq(fleet.search(q), (d_ref, i_ref))
+    assert "r0: dead" in fleet.describe()
+
+    # scrape parses, and per-replica families carry the injected label
+    from raft_tpu.obs.prometheus import parse_text
+    samples = parse_text(fleet.prometheus_text())
+    assert samples["raft_fleet_shards"][0][1] == 4.0
+    reps = {lab["replica"]
+            for lab, _ in samples["raft_serve_completed_total"]}
+    assert reps == {"r0", "r1"}
+    fleet.stop()
+
+
+def test_fleet_durability_ship_and_promote(flat_index, devices, tmp_path):
+    mesh = _mesh(devices, 2)
+    fleet = FleetServer(flat_index, k=K,
+                        params=ivf_flat.IvfFlatSearchParams(n_probes=5),
+                        mesh=mesh, selftest=False,
+                        config=ServerConfig(ladder=(16,)))
+    dur = fleet.attach_durability(
+        tmp_path, ["hostA", "hostB", "hostC"], n_standbys=2,
+        config=ReplicationConfig(ack_mode="async", lease_s=3.0))
+    assert len(dur.shards) == 2
+    for sh in dur.shards:
+        assert len(sh.standbys) == 2
+        assert sh.assignment.primary not in sh.assignment.standbys
+    dur.pump()
+
+    # a durable mutation on shard 0 ships to both of its standbys
+    s0 = dur.shards[0].store
+    new = np.full((3, 32), 0.5, np.float32)
+    s0.extend(new, np.array([9000, 9001, 9002]))
+    dur.pump()
+    assert all(st.applied == s0.wal_lsn
+               for st in dur.shards[0].standbys)
+    assert all(lag == 0 for shard in dur.lag().values()
+               for lag in shard.values())
+
+    # lease expiry: every shard promotes exactly one standby
+    now = fleet.replicas[0].server.clock() + 100.0
+    promoted = fleet.promote_expired(now)
+    assert promoted == [0, 1]
+    for sh in dur.shards:
+        serving = [st for st in sh.standbys if st.promoted]
+        assert len(serving) == 1 and serving[0].is_serving
+    fleet.stop()
+
+
+def test_shard_sub_indexes_cover_the_whole_index(flat_index):
+    subs = shard_sub_indexes(flat_index, 4)
+    assert len(subs) == 4
+    got = np.sort(np.concatenate(
+        [np.asarray(jax.device_get(s.ids)).ravel() for s in subs]))
+    want = np.sort(np.asarray(jax.device_get(flat_index.ids)).ravel())
+    np.testing.assert_array_equal(got[got >= 0], want[want >= 0])
+    # each sub-index is self-contained: its centroid table matches its
+    # own list count, so durable extend works per shard
+    for s in subs:
+        assert s.centroids.shape[0] == s.data.shape[0]
+
+
+def test_brute_sub_indexes_roundtrip(data):
+    db, q = data
+    subs = shard_sub_indexes(db[:100], 4)
+    stacked = np.concatenate([np.asarray(s) for s in subs])
+    np.testing.assert_array_equal(stacked, db[:100])

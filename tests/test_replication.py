@@ -469,3 +469,58 @@ def test_sigkill_failover_promoted_standby_bit_identical(mode, tmp_path):
     # the promoted store is a writable primary: life goes on
     store.extend(np.ones((2, D), np.float32))
     assert store.wal_lsn == w + 1
+
+
+# ---------------------------------------------------------------------------
+# multi-follower fan-out (ISSUE 16 satellite: WAL shipping to N standbys)
+
+
+def test_two_follower_fanout_acks_floor_and_lag(tmp_path):
+    clk = FakeClock()
+    a1, b1 = QueuePair.create()
+    a2, b2 = QueuePair.create()
+    pstore = DurableStore.create(tmp_path / "primary",
+                                 dur.initial_tombstoned(), clock=clk)
+    cfg = ReplicationConfig(ack_mode="async")
+    reg_p = MetricRegistry()
+    shipper = LogShipper(pstore, [a1, a2], config=cfg, registry=reg_p,
+                         clock=clk)
+    s1 = StandbyReplica(tmp_path / "s1", b1, config=cfg, node_id="s1",
+                        registry=MetricRegistry(), clock=clk)
+    s2 = StandbyReplica(tmp_path / "s2", b2, config=cfg, node_id="s2",
+                        registry=MetricRegistry(), clock=clk)
+    # one pump serves BOTH hellos (snapshot bootstrap is per-link)
+    shipper.pump()
+    s1.poll()
+    s2.poll()
+    shipper.pump()
+    assert s1.store is not None and s2.store is not None
+    assert set(pstore.followers()) == {"s1", "s2"}
+
+    ops = fo.op_list()
+    for op, args in ops:
+        fo.apply_op(pstore, op, args)
+    # only s1 drains: the floor tracks the SLOWEST follower
+    s1.poll()
+    shipper.pump()
+    assert s1.applied == pstore.wal_lsn == len(ops)
+    assert pstore.followers()["s1"] == len(ops)
+    assert pstore.followers()["s2"] == 0
+    assert pstore.follower_floor() == 0
+    lag = shipper.metrics.gauge("raft_replication_follower_lag_lsn", "")
+    assert lag.value(follower="s1") == 0.0
+    assert lag.value(follower="s2") == float(len(ops))
+    assert shipper.metrics.gauge(
+        "raft_replication_lag_lsn", "").value() == float(len(ops))
+
+    # s2 catches up; floor converges and both replicas are bit-identical
+    s2.poll()
+    shipper.pump()
+    assert s2.applied == len(ops)
+    assert pstore.follower_floor() == len(ops)
+    assert lag.value(follower="s2") == 0.0
+    assert_bit_identical(s1.store.index, pstore.index)
+    assert_bit_identical(s2.store.index, pstore.index)
+    s1.stop()
+    s2.stop()
+    shipper.stop()
