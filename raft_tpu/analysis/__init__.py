@@ -8,10 +8,17 @@ never see.  :mod:`.jaxlint` is the AST pass that gates them; the runtime
 side (``raft_tpu.core.trace_guard``) asserts the same properties on live
 dispatches.  Rule catalog: ``docs/jax_hygiene.md``.
 
+:mod:`.racelint` is the concurrency sibling: guarded-attribute writes,
+lock-order consistency, blocking calls under locks, and daemon threads
+touching jax dispatch (JX10..JX14).  Its runtime arm is
+:mod:`raft_tpu.core.lockdep` — instrumented locks that record the
+cross-module lock-order graph the AST pass cannot see.
+
 This package imports only the standard library (no jax) so lint tooling
 can load it without touching an accelerator backend.
 """
 
+from . import racelint
 from .jaxlint import (
     ALL_RULES,
     Finding,
@@ -25,6 +32,7 @@ __all__ = [
     "ALL_RULES",
     "Finding",
     "Report",
+    "racelint",
     "scan_file",
     "scan_source",
     "scan_tree",

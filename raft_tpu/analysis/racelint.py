@@ -1,0 +1,667 @@
+"""racelint — AST rules for the concurrency hazards the threaded serving
+stack can hide from single-process tests.
+
+The fleet tier left the tree with ~50 lock/thread primitives across ~20
+files; :mod:`.jaxlint` (JX01–JX05) gates JAX hygiene but says nothing
+about thread safety.  These five rules do, driven by lightweight
+source-comment annotations (catalog with bad/good snippets:
+``docs/jax_hygiene.md``):
+
+* **JX10** shared-attribute write outside its declared guard — an
+  attribute declared ``# guarded_by: _lock`` is written (assigned,
+  augmented, subscript-stored, or mutated via ``append``/``update``/…)
+  in a method that neither holds ``with self._lock:`` lexically nor is
+  annotated ``# racelint: holds _lock``.  ``__init__``/``__new__`` are
+  exempt (objects under construction are thread-private, and classmethod
+  constructors building via ``cls.__new__`` are invisible to the rule by
+  construction — their writes target a local, not the first parameter).
+* **JX11** inconsistent lock-acquisition order — within one file, if
+  some code path acquires B while holding A and another acquires A while
+  holding B, both inner acquisitions are flagged: two threads on those
+  paths deadlock.  Cross-file composition is the runtime arm's job
+  (:mod:`raft_tpu.core.lockdep` watches the live order graph).
+* **JX12** blocking call while holding a lock — ``sleep``, ``fsync``/
+  ``fdatasync``, socket ``send``/``sendall``/``recv``/``accept``/
+  ``connect``, ``block_until_ready``, ``device_get`` under a held lock
+  serializes every other thread behind a device round-trip or disk/
+  network wait.  Matching strips leading underscores, so an injected
+  ``self._fsync(...)`` seam counts.  (``join`` is deliberately absent:
+  ``str.join``/``os.path.join`` drown the signal — lockdep's hold-time
+  flag covers thread joins dynamically.)
+* **JX13** callback invoked under an undocumented lock — calling a
+  hook-shaped attribute (``on_*``, ``*_hook(s)``, ``*_callback(s)``),
+  directly or via ``for h in self.on_x:``, while a lock is held, unless
+  the hook list's declaration documents it with ``# called_under:
+  _lock``.  Undocumented reentrancy is how callback deadlocks are born;
+  documented reentrancy is a contract callees can read.
+* **JX14** daemon thread touching JAX dispatch — a ``threading.Thread``
+  whose target (including same-class helpers it calls) references
+  ``jax``/``jnp``, outside the pallas gate module.  Background dispatch
+  must either go through the gate or own its compiled executable; the
+  waiver's reason is where that ownership gets written down.
+
+Annotations::
+
+    self._pending = []        # guarded_by: _cond
+    self.on_commit = []       # called_under: _lock ships in LSN order
+    def _write(self, ...):    # racelint: holds _lock
+
+Per-line waivers, jaxlint-style (reason mandatory — a bare ``disable=``
+is itself a finding, **JXW1**, not waivable)::
+
+    self._fsync(fd)  # racelint: disable=JX12 maintenance path, appends go lock-free
+
+Pure standard library (``ast``); importable without jax.  Entry point:
+``python scripts/mini_lint.py --race raft_tpu``; census artifact:
+``bench/RACELINT.json``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+__all__ = ["ALL_RULES", "Finding", "Report", "scan_source", "scan_file",
+           "scan_tree"]
+
+ALL_RULES: Dict[str, str] = {
+    "JX10": "shared-attribute write outside its declared guard",
+    "JX11": "inconsistent lock-acquisition order (deadlock cycle)",
+    "JX12": "blocking call while holding a lock",
+    "JX13": "callback invoked under an undocumented lock",
+    "JX14": "daemon thread touching JAX dispatch without the gate",
+    "JXW1": "waiver without a written reason",
+}
+
+# drivers/tests own their blocking and their threads; guard discipline
+# (JX10/JX11) is annotation-driven, so it applies tree-wide
+_JX12_ALLOW_SEGMENTS = {"tests", "bench", "scripts"}
+_JX14_ALLOW_SEGMENTS = {"tests", "bench", "scripts"}
+_JX14_ALLOW_FILES = ("ops/pallas/gate.py",)  # the probe IS the gate
+
+_WAIVER_RE = re.compile(
+    r"#\s*racelint:\s*disable=([A-Z0-9]+(?:\s*,\s*[A-Z0-9]+)*)\s*(.*)")
+_GUARD_RE = re.compile(r"#\s*guarded_by:\s*([A-Za-z_]\w*)")
+_CALLED_UNDER_RE = re.compile(r"#\s*called_under:\s*([A-Za-z_]\w*)")
+_HOLDS_RE = re.compile(r"#\s*racelint:\s*holds\s+([A-Za-z_]\w*)")
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "lock", "rlock", "condition"}
+_BLOCKING = {"sleep", "fsync", "fdatasync", "sendall", "send", "sendto",
+             "recv", "recv_into", "recvfrom", "accept", "connect",
+             "block_until_ready", "device_get"}
+_MUTATORS = {"append", "appendleft", "extend", "extendleft", "insert",
+             "pop", "popleft", "remove", "discard", "clear", "add",
+             "update", "setdefault", "sort"}
+_HOOKISH = re.compile(r"^on_|(_hooks?|_callbacks?)$")
+_JAX_ROOTS = {"jax", "jnp"}
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule hit.  ``waived`` hits are kept for stats but do not fail
+    the lint; ``reason`` carries the waiver's justification text."""
+
+    path: str
+    line: int
+    code: str
+    msg: str
+    waived: bool = False
+    reason: str = ""
+
+
+@dataclasses.dataclass
+class Report:
+    """Tree-scan result: active findings, audited waivers, file count."""
+
+    findings: List[Finding]
+    waived: List[Finding]
+    files: int
+
+    def rules_fired(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.findings + self.waived:
+            out[f.code] = out.get(f.code, 0) + 1
+        return out
+
+    def stats(self) -> dict:
+        """The ``bench/RACELINT.json`` schema (same shape as
+        JAXLINT.json so the ratchet tooling reads both)."""
+        waivers: Dict[str, int] = {}
+        for f in self.waived:
+            waivers[f.code] = waivers.get(f.code, 0) + 1
+        return {
+            "tool": "racelint",
+            "files_scanned": self.files,
+            "rules_fired": self.rules_fired(),
+            "unwaived_findings": len(self.findings),
+            "waivers": waivers,
+            "waiver_total": len(self.waived),
+            "waiver_sites": sorted(
+                f"{f.path}:{f.line} {f.code} {f.reason}" for f in self.waived),
+            "rule_catalog": dict(ALL_RULES),
+        }
+
+
+# ---------------------------------------------------------------------------
+# annotation + helper plumbing
+
+
+def _attr_chain(node: ast.AST) -> List[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return []
+
+
+def _is_lock_ctor(value: ast.AST) -> bool:
+    """``threading.Lock()`` / ``lockdep.lock("...")`` / bare
+    ``Condition()`` — anything whose callee bottoms out in a lock ctor
+    name."""
+    if not isinstance(value, ast.Call):
+        return False
+    chain = _attr_chain(value.func)
+    return bool(chain) and chain[-1] in _LOCK_CTORS
+
+
+def _first_param(fn: ast.FunctionDef) -> Optional[str]:
+    args = fn.args.posonlyargs + fn.args.args
+    return args[0].arg if args else None
+
+
+@dataclasses.dataclass
+class _ClassInfo:
+    name: str
+    locks: Set[str] = dataclasses.field(default_factory=set)
+    guards: Dict[str, str] = dataclasses.field(default_factory=dict)
+    called_under: Dict[str, str] = dataclasses.field(default_factory=dict)
+    methods: Dict[str, ast.FunctionDef] = dataclasses.field(
+        default_factory=dict)
+    jax_methods: Set[str] = dataclasses.field(default_factory=set)
+
+
+def _line_annotations(src: str):
+    guards: Dict[int, str] = {}
+    called: Dict[int, str] = {}
+    holds: Dict[int, str] = {}
+    waivers: Dict[int, Tuple[set, str]] = {}
+    for i, line in enumerate(src.split("\n"), 1):
+        m = _GUARD_RE.search(line)
+        if m:
+            guards[i] = m.group(1)
+        m = _CALLED_UNDER_RE.search(line)
+        if m:
+            called[i] = m.group(1)
+        m = _HOLDS_RE.search(line)
+        if m:
+            holds[i] = m.group(1)
+        m = _WAIVER_RE.search(line)
+        if m:
+            codes = {c.strip() for c in m.group(1).split(",")}
+            waivers[i] = (codes, m.group(2).strip())
+    return guards, called, holds, waivers
+
+
+def _mentions_jax(fn: ast.FunctionDef) -> bool:
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Name) and sub.id in _JAX_ROOTS:
+            return True
+        if isinstance(sub, (ast.Import, ast.ImportFrom)):
+            for alias in sub.names:
+                if alias.name.split(".")[0] in _JAX_ROOTS:
+                    return True
+    return False
+
+
+def _self_calls(fn: ast.FunctionDef, self_name: str) -> Set[str]:
+    out: Set[str] = set()
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute):
+            v = sub.func.value
+            if isinstance(v, ast.Name) and v.id == self_name:
+                out.add(sub.func.attr)
+    return out
+
+
+def _collect_class(node: ast.ClassDef, guard_lines: Dict[int, str],
+                   called_lines: Dict[int, str]) -> _ClassInfo:
+    info = _ClassInfo(node.name)
+    for stmt in node.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            info.methods[stmt.name] = stmt
+            self_name = _first_param(stmt)
+            for sub in ast.walk(stmt):
+                targets: List[ast.AST] = []
+                value = None
+                if isinstance(sub, ast.Assign):
+                    targets, value = list(sub.targets), sub.value
+                elif isinstance(sub, ast.AnnAssign):
+                    targets, value = [sub.target], sub.value
+                for t in targets:
+                    if isinstance(t, ast.Attribute) and isinstance(
+                            t.value, ast.Name) and t.value.id in (
+                                self_name, "self"):
+                        if value is not None and _is_lock_ctor(value):
+                            info.locks.add(t.attr)
+                        g = guard_lines.get(sub.lineno)
+                        if g:
+                            info.guards[t.attr] = g
+                        c = called_lines.get(sub.lineno)
+                        if c:
+                            info.called_under[t.attr] = c
+        elif isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    g = guard_lines.get(stmt.lineno)
+                    if g:
+                        info.guards[t.id] = g
+    # transitive same-class jax taint for JX14 (fixpoint over self-calls)
+    mentions = {name: _mentions_jax(fn)
+                for name, fn in info.methods.items()}
+    calls = {name: _self_calls(fn, _first_param(fn) or "self")
+             for name, fn in info.methods.items()}
+    changed = True
+    while changed:
+        changed = False
+        for name in info.methods:
+            if mentions[name]:
+                continue
+            if any(mentions.get(c, False) for c in calls[name]):
+                mentions[name] = True
+                changed = True
+    info.jax_methods = {n for n, hit in mentions.items() if hit}
+    return info
+
+
+# ---------------------------------------------------------------------------
+# the scanner
+
+
+class _FileScanner:
+    def __init__(self, rel: str, src: str) -> None:
+        self.rel = (rel or "").replace(os.sep, "/")
+        segs = set(self.rel.split("/")[:-1])
+        base = os.path.basename(self.rel)
+        is_test = base.startswith("test_") or base == "conftest.py"
+        self.jx12_exempt = bool(segs & _JX12_ALLOW_SEGMENTS) or is_test
+        self.jx13_exempt = is_test or bool(segs & {"tests"})
+        self.jx14_exempt = bool(segs & _JX14_ALLOW_SEGMENTS) or is_test \
+            or any(self.rel.endswith(f) for f in _JX14_ALLOW_FILES)
+        (self.guard_lines, self.called_lines, self.holds_lines,
+         self.waivers) = _line_annotations(src)
+        self.raw: List[Tuple[int, int, str, str]] = []
+        self.mod_locks: Set[str] = set()
+        self.mod_guards: Dict[str, str] = {}
+        self.mod_fn_jax: Dict[str, bool] = {}
+        self.edges: List[Tuple[str, str, int, int]] = []  # a, b, line, end
+
+    def _hit(self, node: ast.AST, code: str, msg: str) -> None:
+        self.raw.append((node.lineno, getattr(node, "end_lineno",
+                                              node.lineno), code, msg))
+
+    # -- lock resolution ----------------------------------------------------
+
+    def _resolve_lock(self, expr: ast.AST, cls: Optional[_ClassInfo],
+                      self_name: Optional[str]) -> Optional[str]:
+        if isinstance(expr, ast.Attribute) and isinstance(
+                expr.value, ast.Name):
+            if cls is not None and expr.value.id == self_name \
+                    and expr.attr in cls.locks:
+                return f"{cls.name}.{expr.attr}"
+            return None
+        if isinstance(expr, ast.Name) and expr.id in self.mod_locks:
+            return f"<module>.{expr.id}"
+        return None
+
+    def _qualify_guard(self, guard: str, cls: Optional[_ClassInfo]) -> str:
+        if cls is not None and guard in cls.locks:
+            return f"{cls.name}.{guard}"
+        if guard in self.mod_locks:
+            return f"<module>.{guard}"
+        # a guard naming a lock the scanner can't see (e.g. injected):
+        # fall back to the raw name so `holds` annotations still match
+        return guard
+
+    # -- module scan --------------------------------------------------------
+
+    def scan(self, tree: ast.Module) -> None:
+        # module-level locks + guarded globals first (order-independent)
+        for stmt in tree.body:
+            targets = []
+            value = None
+            if isinstance(stmt, ast.Assign):
+                targets, value = stmt.targets, stmt.value
+            elif isinstance(stmt, ast.AnnAssign):
+                targets, value = [stmt.target], stmt.value
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    if value is not None and _is_lock_ctor(value):
+                        self.mod_locks.add(t.id)
+                    g = self.guard_lines.get(stmt.lineno)
+                    if g:
+                        self.mod_guards[t.id] = g
+        mod_fns = {s.name: s for s in tree.body
+                   if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        self.mod_fn_jax = {n: _mentions_jax(fn) for n, fn in mod_fns.items()}
+        for stmt in tree.body:
+            if isinstance(stmt, ast.ClassDef):
+                cls = _collect_class(stmt, self.guard_lines,
+                                     self.called_lines)
+                for m in cls.methods.values():
+                    self._scan_function(m, cls)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_function(stmt, None)
+            else:
+                # module-level statements: with-blocks at import time
+                self._walk_stmt(stmt, [], None, None, in_ctor=True)
+        self._emit_jx11()
+
+    def _emit_jx11(self) -> None:
+        pairs = {(a, b) for a, b, _, _ in self.edges}
+
+        def reachable(src: str, dst: str) -> bool:
+            seen, stack = {src}, [src]
+            while stack:
+                n = stack.pop()
+                if n == dst:
+                    return True
+                for (a, b) in pairs:
+                    if a == n and b not in seen:
+                        seen.add(b)
+                        stack.append(b)
+            return False
+
+        for a, b, line, end in self.edges:
+            if reachable(b, a):
+                self.raw.append((
+                    line, end, "JX11",
+                    f"acquires {b} while holding {a}, but another path"
+                    f" orders {b} before {a} — two threads on these paths"
+                    " deadlock; pick one global order"))
+
+    # -- function scan ------------------------------------------------------
+
+    def _scan_function(self, fn: ast.FunctionDef,
+                       cls: Optional[_ClassInfo]) -> None:
+        self_name = _first_param(fn) if cls is not None else None
+        held: List[str] = []
+        h = self.holds_lines.get(fn.lineno)
+        if h is None and fn.body:
+            # decorated defs: the annotation may sit on the def line while
+            # lineno points at the first decorator
+            for cand in range(fn.lineno, fn.body[0].lineno):
+                if cand in self.holds_lines:
+                    h = self.holds_lines[cand]
+                    break
+        if h:
+            held.append(self._qualify_guard(h, cls))
+        in_ctor = cls is not None and fn.name in ("__init__", "__new__")
+        for stmt in fn.body:
+            self._walk_stmt(stmt, held, cls, self_name, in_ctor=in_ctor)
+
+    def _walk_stmt(self, stmt: ast.stmt, held: List[str],
+                   cls: Optional[_ClassInfo], self_name: Optional[str],
+                   *, in_ctor: bool) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested def runs later, under whatever locks its caller
+            # holds — scan it with a clean slate (its own holds apply)
+            self._scan_function(stmt, None)
+            return
+        if isinstance(stmt, ast.ClassDef):
+            return
+        if isinstance(stmt, ast.For):
+            self._check_hook_loop(stmt, held, cls, self_name)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            acquired: List[str] = []
+            for item in stmt.items:
+                self._check_expr(item.context_expr, held, cls, self_name,
+                                 in_ctor=in_ctor, is_with_item=True)
+                name = self._resolve_lock(item.context_expr, cls, self_name)
+                if name is not None:
+                    for outer in held + acquired:
+                        if outer != name:
+                            self.edges.append((outer, name,
+                                               item.context_expr.lineno,
+                                               stmt.lineno))
+                    acquired.append(name)
+            inner = held + acquired
+            for s in stmt.body:
+                self._walk_stmt(s, inner, cls, self_name, in_ctor=in_ctor)
+            return
+        # statement-level writes (JX10)
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            for t in targets:
+                self._check_write(t, stmt, held, cls, self_name,
+                                  in_ctor=in_ctor)
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                self._check_write(t, stmt, held, cls, self_name,
+                                  in_ctor=in_ctor)
+        # expressions within this statement (calls: JX12/13/14 + mutators)
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._check_expr(child, held, cls, self_name,
+                                 in_ctor=in_ctor)
+        # recurse into control-flow bodies with the same held set
+        for field in ("body", "orelse", "finalbody"):
+            for s in getattr(stmt, field, []) or []:
+                if isinstance(s, ast.stmt):
+                    self._walk_stmt(s, held, cls, self_name,
+                                    in_ctor=in_ctor)
+        for handler in getattr(stmt, "handlers", []) or []:
+            for s in handler.body:
+                self._walk_stmt(s, held, cls, self_name, in_ctor=in_ctor)
+
+    # -- write + call checks ------------------------------------------------
+
+    def _guard_of(self, target: ast.AST, cls: Optional[_ClassInfo],
+                  self_name: Optional[str]
+                  ) -> Optional[Tuple[str, str, str]]:
+        """(attr_display, qualified_guard, raw_guard) when ``target`` is a
+        guarded attribute reference."""
+        if isinstance(target, ast.Attribute) and isinstance(
+                target.value, ast.Name):
+            if cls is not None and target.value.id == self_name \
+                    and target.attr in cls.guards:
+                raw = cls.guards[target.attr]
+                return (f"self.{target.attr}",
+                        self._qualify_guard(raw, cls), raw)
+            return None
+        if isinstance(target, ast.Name) and target.id in self.mod_guards:
+            raw = self.mod_guards[target.id]
+            return (target.id, self._qualify_guard(raw, None), raw)
+        return None
+
+    def _check_write(self, target: ast.AST, stmt: ast.stmt,
+                     held: List[str], cls: Optional[_ClassInfo],
+                     self_name: Optional[str], *, in_ctor: bool) -> None:
+        if in_ctor:
+            return
+        base = target
+        if isinstance(base, (ast.Subscript, ast.Starred)):
+            base = base.value
+        g = self._guard_of(base, cls, self_name)
+        if g is None:
+            return
+        attr, qualified, raw = g
+        if qualified in held or raw in held:
+            return
+        self._hit(stmt, "JX10",
+                  f"write to {attr} (guarded_by: {raw}) without holding"
+                  f" {raw}; wrap in `with ...{raw}:` or annotate the"
+                  " method `# racelint: holds" f" {raw}`")
+
+    def _check_expr(self, expr: ast.expr, held: List[str],
+                    cls: Optional[_ClassInfo], self_name: Optional[str],
+                    *, in_ctor: bool, is_with_item: bool = False) -> None:
+        excluded: Set[int] = set()
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Lambda):
+                for leaf in ast.walk(sub):
+                    if leaf is not sub:
+                        excluded.add(id(leaf))
+        for sub in ast.walk(expr):
+            if id(sub) in excluded or not isinstance(sub, ast.Call):
+                continue
+            fn = sub.func
+            attr = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else "")
+            norm = attr.lstrip("_")
+            # JX10 via mutator methods on guarded containers
+            if isinstance(fn, ast.Attribute) and attr in _MUTATORS \
+                    and not in_ctor:
+                g = self._guard_of(fn.value, cls, self_name)
+                if g is not None:
+                    disp, qualified, raw = g
+                    if qualified not in held and raw not in held:
+                        self._hit(sub, "JX10",
+                                  f".{attr}() on {disp} (guarded_by:"
+                                  f" {raw}) without holding {raw}")
+            # JX12 — blocking under a lock
+            if held and norm in _BLOCKING and not self.jx12_exempt \
+                    and not is_with_item:
+                self._hit(sub, "JX12",
+                          f"blocking call {attr}() while holding"
+                          f" {held[-1]} stalls every thread queued on"
+                          " it; move the wait outside the critical"
+                          " section")
+            # JX13 — hook under an undocumented lock
+            if held and not self.jx13_exempt:
+                hook_attr = None
+                if isinstance(fn, ast.Attribute) and isinstance(
+                        fn.value, ast.Name) and fn.value.id == self_name \
+                        and _HOOKISH.search(attr):
+                    hook_attr = attr
+                if hook_attr is not None and cls is not None \
+                        and hook_attr not in cls.called_under:
+                    self._hit(sub, "JX13",
+                              f"callback self.{hook_attr}(...) invoked"
+                              f" while holding {held[-1]} but its"
+                              " declaration does not document it; add"
+                              " `# called_under:" f" {held[-1].split('.')[-1]}`"
+                              " to the attribute or move the call out")
+            # JX14 — thread creation with a jax-touching target
+            chain = _attr_chain(fn)
+            if chain and chain[-1] == "Thread" and not self.jx14_exempt:
+                target_name, target_jax = None, False
+                for kw in sub.keywords:
+                    if kw.arg == "target":
+                        v = kw.value
+                        if isinstance(v, ast.Attribute) and isinstance(
+                                v.value, ast.Name):
+                            target_name = v.attr
+                            if cls is not None and v.value.id == self_name:
+                                target_jax = v.attr in cls.jax_methods
+                        elif isinstance(v, ast.Name):
+                            target_name = v.id
+                            target_jax = self.mod_fn_jax.get(v.id, False)
+                if target_jax:
+                    self._hit(sub, "JX14",
+                              f"thread target {target_name} reaches jax"
+                              " dispatch from a background thread; route"
+                              " it through the pallas gate or document"
+                              " the owned executable in a waiver")
+
+    def _check_hook_loop(self, stmt: ast.For, held: List[str],
+                         cls: Optional[_ClassInfo],
+                         self_name: Optional[str]) -> None:
+        if not held or self.jx13_exempt or cls is None:
+            return
+        it = stmt.iter
+        if isinstance(it, ast.Call) and isinstance(it.func, ast.Name) \
+                and it.func.id in ("list", "tuple", "sorted") and it.args:
+            it = it.args[0]
+        if not (isinstance(it, ast.Attribute) and isinstance(
+                it.value, ast.Name) and it.value.id == self_name):
+            return
+        attr = it.attr
+        if not _HOOKISH.search(attr) or attr in cls.called_under:
+            return
+        if not isinstance(stmt.target, ast.Name):
+            return
+        var = stmt.target.id
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name) \
+                    and sub.func.id == var:
+                self._hit(sub, "JX13",
+                          f"hook from self.{attr} invoked while holding"
+                          f" {held[-1]} but the attribute's declaration"
+                          " does not document it; add `# called_under:"
+                          f" {held[-1].split('.')[-1]}` or call outside"
+                          " the lock")
+
+
+# ---------------------------------------------------------------------------
+# entry points
+
+
+def scan_source(src: str, path: str, rel: Optional[str] = None
+                ) -> List[Finding]:
+    """Scan one source string; returns all findings, waived ones marked.
+
+    ``rel`` is the path relative to the scan root (used for the
+    driver/test allowlists); defaults to ``path``."""
+    try:
+        tree = ast.parse(src, path)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 0, "JX99",
+                        f"unparseable: {e.msg}")]
+    scanner = _FileScanner(rel if rel is not None else path, src)
+    scanner.scan(tree)
+    findings: List[Finding] = []
+    waivers = scanner.waivers
+    for line, end, code, msg in sorted(scanner.raw):
+        waived, reason = False, ""
+        for cand in (line, end):
+            codes_reason = waivers.get(cand)
+            if codes_reason and code in codes_reason[0]:
+                waived, reason = True, codes_reason[1]
+                break
+        findings.append(Finding(path, line, code, msg, waived, reason))
+    for line, (codes, reason) in sorted(waivers.items()):
+        if not reason:
+            findings.append(Finding(
+                path, line, "JXW1",
+                f"waiver for {','.join(sorted(codes))} has no written"
+                " reason; justify it or fix the hazard"))
+    return findings
+
+
+def scan_file(path: str, root: Optional[str] = None) -> List[Finding]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    rel = os.path.relpath(path, root) if root else path
+    return scan_source(src, path, rel)
+
+
+def scan_tree(root: str) -> Report:
+    """Walk ``root`` (skipping caches/VCS dirs) and aggregate a
+    :class:`Report`."""
+    skip = {".git", "__pycache__", ".claude", "node_modules", ".venv"}
+    active: List[Finding] = []
+    waived: List[Finding] = []
+    files = 0
+    base = root if os.path.isdir(root) else os.path.dirname(root) or "."
+    paths = []
+    if os.path.isdir(root):
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames if d not in skip]
+            paths.extend(os.path.join(dirpath, fn)
+                         for fn in filenames if fn.endswith(".py"))
+    else:
+        paths = [root]
+    for path in sorted(paths):
+        files += 1
+        for f in scan_file(path, base):
+            (waived if f.waived else active).append(f)
+    return Report(active, waived, files)

@@ -24,10 +24,11 @@ lifetime a double-buffered pinned staging ring gives in the reference.
 from __future__ import annotations
 
 import contextlib
-import threading
 from typing import Dict, List, Tuple
 
 import numpy as np
+
+from . import lockdep
 
 __all__ = ["HostBufferPool", "default_host_pool",
            "export_host_pool_metrics"]
@@ -51,12 +52,12 @@ class HostBufferPool:
     """
 
     def __init__(self, limit_bytes: int = 1 << 31):
-        self._lock = threading.Lock()
-        self._free: Dict[Tuple[Tuple[int, ...], str], List[np.ndarray]] = {}
+        self._lock = lockdep.lock("HostBufferPool._lock")
+        self._free: Dict[Tuple[Tuple[int, ...], str], List[np.ndarray]] = {}  # guarded_by: _lock
         self._limit = int(limit_bytes)
-        self._held = 0
-        self._hits = 0
-        self._misses = 0
+        self._held = 0    # guarded_by: _lock
+        self._hits = 0    # guarded_by: _lock
+        self._misses = 0  # guarded_by: _lock
 
     @staticmethod
     def _key(shape, dtype):

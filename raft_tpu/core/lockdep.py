@@ -1,0 +1,326 @@
+"""lockdep — instrumented locks with runtime lock-order tracking.
+
+The static arm (:mod:`raft_tpu.analysis.racelint`) proves lock
+discipline *within* a file: guarded attributes are written under their
+declared guard, no blocking call sits under a lock, acquisition order is
+consistent method-to-method.  What it cannot see is the cross-module
+composition at runtime — a ``DurableStore`` commit hook calling into a
+``LogShipper`` that takes its own condition, a compaction daemon
+swapping an index through the server's registry.  This module is that
+runtime arm: drop-in ``Lock``/``RLock``/``Condition`` wrappers that
+
+* record every *nested* acquisition as an edge in a process-global
+  lock-order graph (``A held while acquiring B`` → edge A→B),
+* detect **inversions** at acquisition time — acquiring B while a path
+  B→…→A already exists for some held A means two threads can deadlock;
+  the event is recorded (thread names, both orders) and counted as
+  ``raft_lockdep_inversions_total`` rather than raised, so production
+  keeps serving while the graph evidence lands in metrics,
+* measure hold times into the obs :class:`~raft_tpu.obs.metrics.
+  MetricRegistry` (``raft_lockdep_hold_seconds{lock=}`` histogram), and
+* flag **blocking-under-lock** dynamically: a hold longer than
+  ``RAFT_LOCKDEP_HOLD_S`` (default 0.1 s) counts
+  ``raft_lockdep_blocking_holds_total{lock=}`` — the runtime mirror of
+  racelint's JX12.
+
+The wrappers are constructed unconditionally (``lockdep.lock("name")``
+everywhere a ``threading.Lock()`` used to be) but instrumentation is
+**off by default**: a disabled acquire is one attribute load + branch on
+top of the raw lock, so the serving hot path pays nothing.  Tests arm it
+via the ``lockdep_enabled`` fixture (``tests/conftest.py``); production
+arms it with ``RAFT_LOCKDEP=1`` (and ``RAFT_LOCKDEP_REPORT=<path>``
+makes the test session write the edge/inversion census on exit — the
+zero-inversion gate ``tests/test_lockdep.py`` runs over the threaded
+suites).
+
+Pure standard library; the obs registry import is lazy and the
+registry's own internal locks stay *plain* ``threading.Lock`` — the
+metrics surface is a leaf the instrumentation reports into, never
+through.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["lock", "rlock", "condition", "enable", "disable", "enabled",
+           "reset", "inversions", "edges", "held", "report",
+           "hold_threshold_s"]
+
+# module state below is guarded by _state_lock (a raw lock: lockdep must
+# not instrument itself); the _enabled flag is a bare bool read on every
+# acquire — torn reads are impossible for a Python bool and a stale read
+# only delays arming by one acquisition
+_state_lock = threading.Lock()
+_edges: Dict[Tuple[str, str], Tuple[str, str]] = {}  # (a,b) -> (thread, where)
+_inversions: List[dict] = []
+_enabled = os.environ.get("RAFT_LOCKDEP", "") == "1"
+_hold_threshold_s = float(os.environ.get("RAFT_LOCKDEP_HOLD_S", "0.1"))
+
+_tls = threading.local()
+
+
+def _held_stack() -> List["_Instrumented"]:
+    stack = getattr(_tls, "held", None)
+    if stack is None:
+        stack = _tls.held = []
+    return stack
+
+
+def enable() -> None:
+    """Arm instrumentation process-wide (all existing wrappers included)."""
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def hold_threshold_s(value: Optional[float] = None) -> float:
+    """Get (and with ``value`` set) the blocking-hold flag threshold."""
+    global _hold_threshold_s
+    if value is not None:
+        _hold_threshold_s = float(value)
+    return _hold_threshold_s
+
+
+def reset() -> None:
+    """Clear the order graph + inversion log (test isolation)."""
+    with _state_lock:
+        _edges.clear()
+        del _inversions[:]
+
+
+def edges() -> Dict[Tuple[str, str], Tuple[str, str]]:
+    """Snapshot of the observed lock-order graph."""
+    with _state_lock:
+        return dict(_edges)
+
+
+def inversions() -> List[dict]:
+    """Snapshot of recorded lock-order inversions (potential deadlocks)."""
+    with _state_lock:
+        return list(_inversions)
+
+
+def held() -> List[str]:
+    """Names of locks the *calling* thread currently holds, outermost
+    first."""
+    return [lk.name for lk in _held_stack()]
+
+
+def report() -> dict:
+    """JSON-able census: the artifact ``RAFT_LOCKDEP_REPORT`` writes."""
+    with _state_lock:
+        return {
+            "tool": "lockdep",
+            "enabled": _enabled,
+            "edges": sorted(f"{a} -> {b}" for a, b in _edges),
+            "inversions": list(_inversions),
+            "inversion_total": len(_inversions),
+        }
+
+
+def _path_exists(src: str, dst: str) -> bool:
+    """DFS over _edges: is there an order path src → … → dst?  Caller
+    holds _state_lock."""
+    seen = {src}
+    stack = [src]
+    while stack:
+        node = stack.pop()
+        if node == dst:
+            return True
+        for (a, b) in _edges:
+            if a == node and b not in seen:
+                seen.add(b)
+                stack.append(b)
+    return False
+
+
+def _metrics():
+    """The obs registry, or None when the obs package is unavailable
+    (lockdep must work from a bare interpreter)."""
+    try:
+        from ..obs.metrics import registry
+        return registry()
+    except Exception:  # pragma: no cover - obs is part of this package
+        return None
+
+
+def _observe_hold(name: str, dt: float) -> None:
+    reg = _metrics()
+    if reg is None:
+        return
+    reg.histogram(
+        "raft_lockdep_hold_seconds",
+        "lock hold time in seconds (lockdep instrumentation)",
+        boundaries=(1e-5, 1e-4, 1e-3, 1e-2, 0.1, 1.0, 10.0),
+    ).observe(dt, lock=name)
+    if dt >= _hold_threshold_s:
+        reg.counter(
+            "raft_lockdep_blocking_holds_total",
+            "holds exceeding RAFT_LOCKDEP_HOLD_S — blocking under a lock",
+        ).inc(lock=name)
+
+
+def _count_inversion() -> None:
+    reg = _metrics()
+    if reg is not None:
+        reg.counter(
+            "raft_lockdep_inversions_total",
+            "lock-order inversions observed at acquisition time",
+        ).inc()
+
+
+class _Instrumented:
+    """Shared acquire/release bookkeeping over a raw primitive.
+
+    Subclasses set ``_raw``; RLock re-entry is detected via the held
+    stack (an inner re-acquire adds no edge and keeps the outer hold
+    timer running)."""
+
+    def __init__(self, name: str, raw) -> None:
+        self.name = name
+        self._raw = raw
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<lockdep {type(self).__name__} {self.name!r}>"
+
+    # -- bookkeeping ---------------------------------------------------
+
+    def _note_acquired(self) -> None:
+        stack = _held_stack()
+        if any(e is self for e in stack):  # RLock re-entry: no new edge
+            stack.append(self)
+            return
+        if stack:
+            top_names = [e.name for e in stack if e.name != self.name]
+            where = threading.current_thread().name
+            new_inversions = 0
+            with _state_lock:
+                for a in top_names:
+                    if (a, self.name) not in _edges:
+                        if _path_exists(self.name, a):
+                            _inversions.append({
+                                "acquiring": self.name,
+                                "while_holding": a,
+                                "thread": where,
+                                "established": _edges.get(
+                                    (self.name, a), ("?", "?"))[0],
+                            })
+                            new_inversions += 1
+                        _edges[(a, self.name)] = (where, "runtime")
+            for _ in range(new_inversions):
+                _count_inversion()
+        stack.append(self)
+        self._t0 = time.monotonic()
+
+    def _note_released(self) -> None:
+        stack = _held_stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is self:
+                del stack[i]
+                break
+        if not any(e is self for e in stack):  # outermost release
+            t0 = getattr(self, "_t0", None)
+            if t0 is not None:
+                self._t0 = None
+                _observe_hold(self.name, time.monotonic() - t0)
+
+    # -- lock protocol -------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._raw.acquire(blocking, timeout)
+        if ok and _enabled:
+            self._note_acquired()
+        return ok
+
+    def release(self) -> None:
+        if _enabled:
+            self._note_released()
+        self._raw.release()
+
+    def locked(self) -> bool:
+        return self._raw.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class _InstrumentedLock(_Instrumented):
+    def __init__(self, name: str) -> None:
+        super().__init__(name, threading.Lock())
+
+
+class _InstrumentedRLock(_Instrumented):
+    def __init__(self, name: str) -> None:
+        super().__init__(name, threading.RLock())
+
+
+class _InstrumentedCondition(_Instrumented):
+    """Condition over an instrumented (R)Lock.  ``wait`` releases the
+    lock for its duration — the held stack and hold timer mirror that,
+    so a 30 s ``wait`` does not read as a 30 s hold."""
+
+    def __init__(self, name: str, lock=None) -> None:
+        raw = threading.Condition(
+            lock._raw if isinstance(lock, _Instrumented) else lock)
+        super().__init__(name, raw)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        if _enabled:
+            self._note_released()
+        try:
+            return self._raw.wait(timeout)
+        finally:
+            if _enabled:
+                self._note_acquired()
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        if _enabled:
+            self._note_released()
+        try:
+            return self._raw.wait_for(predicate, timeout)
+        finally:
+            if _enabled:
+                self._note_acquired()
+
+    def notify(self, n: int = 1) -> None:
+        self._raw.notify(n)
+
+    def notify_all(self) -> None:
+        self._raw.notify_all()
+
+    def locked(self) -> bool:  # pragma: no cover - parity with Lock API
+        return self._raw._lock.locked()
+
+
+def lock(name: str) -> _InstrumentedLock:
+    """A ``threading.Lock`` with lockdep instrumentation (off until
+    :func:`enable`).  ``name`` keys the order graph and the metric
+    label — use ``Class._attr`` / ``module:_name`` so graph nodes read
+    like the source."""
+    return _InstrumentedLock(name)
+
+
+def rlock(name: str) -> _InstrumentedRLock:
+    """Instrumented ``threading.RLock`` (re-entry adds no edges)."""
+    return _InstrumentedRLock(name)
+
+
+def condition(name: str, lock=None) -> _InstrumentedCondition:
+    """Instrumented ``threading.Condition`` (wait releases the hold)."""
+    return _InstrumentedCondition(name, lock)

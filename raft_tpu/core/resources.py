@@ -23,12 +23,12 @@ first use.
 
 from __future__ import annotations
 
-import threading
 from typing import Any, Callable, Dict, Optional, Sequence
 
 import jax
 import numpy as np
 
+from . import lockdep
 from .errors import RaftError, expects
 
 __all__ = [
@@ -70,9 +70,9 @@ class Resources:
     HOST_POOL = "host_pool"
 
     def __init__(self, **overrides: Any) -> None:
-        self._lock = threading.RLock()
-        self._cells: Dict[str, Any] = {}
-        self._factories: Dict[str, Callable[["Resources"], Any]] = {}
+        self._lock = lockdep.rlock("Resources._lock")
+        self._cells: Dict[str, Any] = {}  # guarded_by: _lock
+        self._factories: Dict[str, Callable[["Resources"], Any]] = {}  # guarded_by: _lock
         self._install_default_factories()
         for name, value in overrides.items():
             self.set_resource(name, value)
@@ -106,7 +106,7 @@ class Resources:
     def copy(self) -> "Resources":
         """A copy *shares* realized resource cells (``resources.hpp`` copy ctor)."""
         other = Resources.__new__(Resources)
-        other._lock = threading.RLock()
+        other._lock = lockdep.rlock("Resources._lock")
         with self._lock:
             other._cells = dict(self._cells)
             other._factories = dict(self._factories)
@@ -165,8 +165,8 @@ class Resources:
 
 class _Counter:
     def __init__(self) -> None:
-        self._v = 0
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("resources._Counter._lock")
+        self._v = 0  # guarded_by: _lock
 
     def next(self) -> int:
         with self._lock:
@@ -216,8 +216,8 @@ class DeviceResources(Resources):
             self.set_resource(Resources.RNG_SEED, seed)
 
 
-_default: Optional[Resources] = None
-_default_lock = threading.Lock()
+_default: Optional[Resources] = None  # guarded_by: _default_lock
+_default_lock = lockdep.lock("resources._default_lock")
 
 
 def default_resources() -> Resources:

@@ -13,11 +13,11 @@ first get_device_resources").
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, Optional, Tuple
 
 import jax
 
+from . import lockdep
 from .resources import DeviceResources, Resources
 
 __all__ = ["DeviceResourcesManager", "get_device_resources"]
@@ -28,8 +28,8 @@ class DeviceResourcesManager:
     all-device handle), built lazily, shared across threads."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._handles: Dict[Optional[int], DeviceResources] = {}
+        self._lock = lockdep.lock("DeviceResourcesManager._lock")
+        self._handles: Dict[Optional[int], DeviceResources] = {}  # guarded_by: _lock
         self._seed = 0
         self._workspace_limit: Optional[int] = None
         self._mesh_axes: Tuple[str, ...] = ("data",)

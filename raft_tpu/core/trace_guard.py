@@ -36,18 +36,19 @@ Both gates ship in the same PR on purpose — see docs/jax_hygiene.md.
 
 from __future__ import annotations
 
-import threading
 from typing import List, Optional
 
 import jax
+
+from . import lockdep
 
 __all__ = ["TraceGuard", "SteadyStateError"]
 
 _TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 
-_lock = threading.Lock()
-_active: List["TraceGuard"] = []
+_lock = lockdep.lock("trace_guard._lock")
+_active: List["TraceGuard"] = []  # guarded_by: _lock
 _listener_registered = False
 
 

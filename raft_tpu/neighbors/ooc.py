@@ -47,7 +47,6 @@ import dataclasses
 import json
 import os
 import shutil
-import threading
 from functools import partial
 from typing import Optional, Tuple
 
@@ -55,7 +54,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core import tracing
+from ..core import lockdep, tracing
 from ..core.array import wrap_array
 from ..core.double_buffer import device_prefetch
 from ..core.errors import expects
@@ -188,8 +187,8 @@ class OocIndex:
 # i.e. there is no hidden full-slab device_put anywhere in the tier.
 # ---------------------------------------------------------------------------
 
-_transfer_lock = threading.Lock()
-_transfer = {"puts": 0, "put_bytes": 0, "max_put_bytes": 0,
+_transfer_lock = lockdep.lock("ooc._transfer_lock")
+_transfer = {"puts": 0, "put_bytes": 0, "max_put_bytes": 0,  # guarded_by: _transfer_lock
              "fetch_bytes": 0}
 
 

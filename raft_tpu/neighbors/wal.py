@@ -44,7 +44,6 @@ import json
 import os
 import shutil
 import struct
-import threading
 import time
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
@@ -52,7 +51,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..core.errors import expects
-from ..core import tracing
+from ..core import lockdep, tracing
 from ..core.serialize import (CorruptArtifact, deserialize_mdspan, fsync_dir,
                               npy_bytes)
 from ..obs import spans as obs_spans
@@ -121,7 +120,17 @@ class WriteAheadLog:
     Opening an existing log scans it (validating every CRC) to resume
     the LSN sequence; a torn/corrupt tail raises :class:`CorruptArtifact`
     — :meth:`DurableStore.recover` quarantines + truncates first, so a
-    plain reopen never silently appends after garbage."""
+    plain reopen never silently appends after garbage.
+
+    Two locks split the append hot path from the durability wait:
+    ``_lock`` covers the file write + LSN sequence (microseconds),
+    ``_sync_lock`` serializes the fsync and its watermarks.  An append
+    writes+flushes under ``_lock``, *releases it*, then settles
+    durability via :meth:`_sync_to` — so while one thread waits on the
+    disk, other appenders keep streaming into the page cache, and the
+    ``_synced_lsn`` watermark lets one fsync retire every append that
+    landed before it (group commit that actually amortizes under
+    contention, not just under a timer)."""
 
     def __init__(self, path: str, config: Optional[WalConfig] = None, *,
                  clock=time.monotonic, _fsync=os.fsync) -> None:
@@ -129,16 +138,21 @@ class WriteAheadLog:
         self.config = config or WalConfig()
         self._clock = clock
         self._fsync = _fsync
-        self._lock = threading.Lock()
-        self._last_sync = float("-inf")
-        self.syncs = 0
+        # _lock: file writes + LSN; _sync_lock: fsync + its watermarks.
+        # Order when nested (prune/close only): _lock -> _sync_lock.
+        self._lock = lockdep.lock("WriteAheadLog._lock")
+        self._sync_lock = lockdep.lock("WriteAheadLog._sync_lock")
+        self._last_sync = float("-inf")  # guarded_by: _sync_lock
+        self._synced_lsn = 0             # guarded_by: _sync_lock
+        self.syncs = 0                   # guarded_by: _sync_lock
         fresh = not os.path.exists(self.path) \
             or os.path.getsize(self.path) == 0
         if fresh:
-            self._lsn = 0
+            self._lsn = 0  # guarded_by: _lock
             self._f = open(self.path, "ab")
             self._f.write(_FILE_HEADER)
-            self._do_sync()
+            with self._sync_lock:
+                self._sync_locked()
         else:
             records, good_end, problems = read_wal(self.path)
             if problems:
@@ -147,6 +161,7 @@ class WriteAheadLog:
                     " — recover via DurableStore.recover, which quarantines"
                     " and truncates it")
             self._lsn = records[-1].lsn if records else 0
+            self._synced_lsn = self._lsn  # on-disk records are the base
             self._f = open(self.path, "ab")
 
     @property
@@ -155,16 +170,25 @@ class WriteAheadLog:
         return self._lsn
 
     def append(self, op: str, arrays: Optional[Dict[str, Any]] = None,
-               static: Optional[Dict[str, Any]] = None) -> int:
+               static: Optional[Dict[str, Any]] = None, *,
+               defer_sync: bool = False) -> int:
         """Write one record and return its LSN.  The record is on disk
         (page cache) when this returns; it is *durable* per the group-
-        commit policy (``WalConfig.group_window_s``)."""
+        commit policy (``WalConfig.group_window_s``).  ``defer_sync=True``
+        skips the durability settle — the caller promises to call
+        :meth:`commit` with the returned LSN after releasing its own
+        locks (how :meth:`DurableStore._durable` keeps the fsync out of
+        the store's critical section)."""
         expects(op in _OPS, f"unknown WAL op {op!r} ({_OPS})")
         payload = _encode_payload(op, arrays or {}, static or {})
         with self._lock:
-            return self._write(self._lsn + 1, payload)
+            lsn = self._write(self._lsn + 1, payload)
+        if not defer_sync:
+            self._maybe_sync(lsn)
+        return lsn
 
-    def append_record(self, rec: "WalRecord") -> int:
+    def append_record(self, rec: "WalRecord", *,
+                      defer_sync: bool = False) -> int:
         """Append an already-sequenced record (the replication apply
         path): ``rec.lsn`` must continue the local sequence; an empty log
         adopts it as the base (a standby bootstrapped from a snapshot at
@@ -175,18 +199,39 @@ class WriteAheadLog:
             expects(self._lsn == 0 or rec.lsn == self._lsn + 1,
                     f"replicated lsn {rec.lsn} does not continue the "
                     f"local wal at {self._lsn}")
-            return self._write(rec.lsn, payload)
+            lsn = self._write(rec.lsn, payload)
+        if not defer_sync:
+            self._maybe_sync(lsn)
+        return lsn
 
     def _write(self, lsn: int, payload: bytes) -> int:
+        # racelint: holds _lock
         self._f.write(_REC_HEADER.pack(lsn, zlib.crc32(payload),
                                        len(payload)))
         self._f.write(payload)
-        self._f.flush()
+        self._f.flush()  # visible to the OS before _lock drops
         self._lsn = lsn
+        return lsn
+
+    def commit(self, lsn: int) -> None:
+        """Settle durability for ``lsn`` per the group-commit policy —
+        the deferred half of ``append(..., defer_sync=True)``.  Call it
+        with no locks held: this is where the disk wait happens."""
+        self._maybe_sync(lsn)
+
+    def _maybe_sync(self, lsn: int) -> None:
+        # _last_sync is read without _sync_lock: a stale read merely
+        # shifts one fsync across the window boundary, and the settle
+        # itself re-checks the watermark under _sync_lock
         w = self.config.group_window_s
         if w <= 0 or self._clock() - self._last_sync >= w:
-            self._do_sync()
-        return lsn
+            self._sync_to(lsn)
+
+    def _sync_to(self, lsn: int) -> None:
+        with self._sync_lock:
+            if self._synced_lsn >= lsn:
+                return  # a later append's fsync already covered us
+            self._sync_locked()
 
     def prune(self, upto_lsn: int) -> int:
         """Atomically rewrite the log without records ``lsn <= upto_lsn``.
@@ -195,8 +240,10 @@ class WriteAheadLog:
         records discarded.  Callers own the safety floor —
         :meth:`DurableStore.prune_wal` clamps to the oldest retained
         snapshot watermark AND every registered follower's ack."""
-        with self._lock:
-            self._do_sync()
+        # maintenance path: both locks held for the whole rewrite —
+        # appenders and fsyncs must not race a file swap
+        with self._lock, self._sync_lock:
+            self._sync_locked()
             records, _, problems = read_wal(self.path)
             if problems:
                 raise CorruptArtifact(
@@ -216,30 +263,40 @@ class WriteAheadLog:
                                              len(payload)))
                     f.write(payload)
                 f.flush()
-                self._fsync(f.fileno())
+                self._fsync(f.fileno())  # racelint: disable=JX12 rare maintenance rewrite; the swap must be atomic w.r.t. appends, which never enter this path
             self._f.close()
             os.replace(tmp, self.path)
             fsync_dir(os.path.dirname(self.path) or ".")
             self._f = open(self.path, "ab")
             self._last_sync = self._clock()
+            self._synced_lsn = self._lsn  # the rewrite is fully durable
             return dropped
 
-    def _do_sync(self) -> None:
+    def _sync_locked(self) -> None:
+        # racelint: holds _sync_lock
+        # reading _lsn without _lock is deliberate: _write only advances
+        # it AFTER the bytes are flushed to the OS, so any value read
+        # here is covered by the fsync below — that is the group-commit
+        # amortization (one disk wait retires every earlier append)
+        target = self._lsn
         self._f.flush()
-        self._fsync(self._f.fileno())
+        self._fsync(self._f.fileno())  # racelint: disable=JX12 the fsync IS this path's job; it serializes on the dedicated _sync_lock while appends stream on under _lock
         self._last_sync = self._clock()
+        self._synced_lsn = max(self._synced_lsn, target)
         self.syncs += 1
 
     def sync(self) -> None:
         """Force-fsync pending records (snapshot watermarks call this so
-        the manifest never claims an LSN the disk doesn't hold)."""
-        with self._lock:
-            self._do_sync()
+        the manifest never claims an LSN the disk doesn't hold).
+        Unconditional: even a covered watermark re-settles, because the
+        caller is about to write the LSN into a manifest."""
+        with self._sync_lock:
+            self._sync_locked()
 
     def close(self) -> None:
-        with self._lock:
+        with self._lock, self._sync_lock:
             if not self._f.closed:
-                self._do_sync()
+                self._sync_locked()
                 self._f.close()
 
 
@@ -353,22 +410,26 @@ class DurableStore:
             os.makedirs(d, exist_ok=True)
         self.config = config or WalConfig()
         self.faults = faults
-        self.index = index
-        self.counters: Dict[str, int] = {}
+        self.index = index          # guarded_by: _lock
+        self.counters: Dict[str, int] = {}  # guarded_by: _lock
         self.metrics = None  # ServingMetrics mirror once a server adopts us
         self.fence = None  # serve.replication.EpochFence once replicated
-        self.on_commit: List[Any] = []  # (lsn, op, arrays, static) hooks
-        self._followers: Dict[str, int] = {}  # follower id -> acked lsn
+        # (lsn, op, arrays, static) hooks — invoked inside the commit
+        # critical section so records enter the wire in LSN order
+        self.on_commit: List[Any] = []  # called_under: _lock
+        self._followers: Dict[str, int] = {}  # guarded_by: _follower_lock
         # followers get their own lock: the ack pump thread must be able
         # to record progress while a semi-sync commit holds _lock
-        self._follower_lock = threading.Lock()
-        self._lock = threading.RLock()
+        self._follower_lock = lockdep.lock("DurableStore._follower_lock")
+        self._lock = lockdep.rlock("DurableStore._lock")
         self.wal = WriteAheadLog(os.path.join(self.root, "wal.log"),
                                  self.config, clock=clock, _fsync=_fsync)
 
     # -- bookkeeping --------------------------------------------------
 
     def _count(self, name: str, n: int = 1) -> None:
+        # racelint: holds _lock  (construction-phase callers — recover,
+        # follower ack bookkeeping — predate or sidestep sharing)
         self.counters[name] = self.counters.get(name, 0) + n
         if self.metrics is not None:
             self.metrics.count(name, n)
@@ -431,6 +492,15 @@ class DurableStore:
             crash_site="compact")
 
     def _durable(self, op, arrays, static, *, crash_site: str):
+        """Log-then-apply under ``_lock``; the fsync settles AFTER the
+        lock drops (``wal.commit``).  The write itself (page cache) and
+        the in-memory apply stay atomic w.r.t. other mutators — LSN
+        order is preserved — but the disk wait no longer serializes
+        readers of the store lock behind the platter.  Power-loss
+        durability is unchanged: ``_durable`` still returns only after
+        the group-commit policy is settled for this LSN, and a *process*
+        crash anywhere in between loses nothing (the bytes are in the
+        OS page cache from the flush under the WAL lock)."""
         with self._lock, tracing.range("wal.durable(%s)", op):
             expects(self.index is not None, "store has no index (use "
                     "DurableStore.create or DurableStore.recover)")
@@ -439,7 +509,7 @@ class DurableStore:
             # corrupt-kind faults at this site byte-flip the existing log
             # (torn-tail drill); crash-kind ones lose the op entirely
             self._fire("wal_append", self.wal.path)
-            lsn = self.wal.append(op, arrays, static)
+            lsn = self.wal.append(op, arrays, static, defer_sync=True)
             self._count("wal_appends")
             # crash here = committed but unapplied: replay restores it
             self._fire(crash_site)
@@ -447,7 +517,9 @@ class DurableStore:
                                                       static))
             for hook in self.on_commit:  # replication ship, in LSN order
                 hook(lsn, op, arrays, static)
-            return self.index
+            out = self.index
+        self.wal.commit(lsn)  # the disk wait, outside the store lock
+        return out
 
     def apply_replicated(self, rec: WalRecord):
         """Standby-side ingest: append the primary's record at its
@@ -458,13 +530,15 @@ class DurableStore:
             expects(self.index is not None, "store has no index (use "
                     "DurableStore.create or DurableStore.recover)")
             self._fire("wal_append", self.wal.path)
-            self.wal.append_record(rec)
+            self.wal.append_record(rec, defer_sync=True)
             self._count("wal_appends")
             self._count("wal_replicated")
             self.index = _apply(self.index, rec)
             for hook in self.on_commit:  # chained replication fan-out
                 hook(rec.lsn, rec.op, rec.arrays, rec.static)
-            return self.index
+            out = self.index
+        self.wal.commit(rec.lsn)  # disk wait outside the store lock
+        return out
 
     # -- follower watermarks (WAL retention floor) --------------------
 
@@ -605,8 +679,8 @@ class DurableStore:
         self.fence = None
         self.on_commit = []
         self._followers = {}
-        self._follower_lock = threading.Lock()
-        self._lock = threading.RLock()
+        self._follower_lock = lockdep.lock("DurableStore._follower_lock")
+        self._lock = lockdep.rlock("DurableStore._lock")
 
         # 1) snapshots: quarantine strays (crashed-mid-publish temp dirs),
         #    then walk published ones newest-first until one verifies

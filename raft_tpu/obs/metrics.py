@@ -47,8 +47,10 @@ class _Family:
     def __init__(self, name: str, help: str = "") -> None:
         self.name = name
         self.help = help
+        # plain Lock on purpose: the metrics surface is the leaf lockdep
+        # itself reports into — instrumenting it would recurse
         self._lock = threading.Lock()
-        self._vals: Dict[Tuple, float] = {}
+        self._vals: Dict[Tuple, float] = {}  # guarded_by: _lock
 
     def samples(self) -> List[Tuple[Dict[str, str], float]]:
         """``[(labels_dict, value), ...]`` sorted by label key."""
@@ -115,9 +117,9 @@ class Histogram:
         self.name = name
         self.help = help
         self.boundaries = bounds
-        self._lock = threading.Lock()
-        self._counts: Dict[Tuple, List[int]] = {}
-        self._sums: Dict[Tuple, float] = {}
+        self._lock = threading.Lock()  # plain on purpose — lockdep reports into histograms
+        self._counts: Dict[Tuple, List[int]] = {}  # guarded_by: _lock
+        self._sums: Dict[Tuple, float] = {}        # guarded_by: _lock
 
     def observe(self, v: float, **labels) -> None:
         i = bisect.bisect_left(self.boundaries, float(v))
@@ -199,8 +201,8 @@ class MetricRegistry:
     error."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()  # plain on purpose — see _Family
+        self._metrics: Dict[str, object] = {}  # guarded_by: _lock
 
     def _get(self, name: str, kind, factory):
         from ..core.errors import expects

@@ -222,15 +222,16 @@ class RecallEstimator:
         self.drift = None          # optional obs.drift.DriftDetector
         self._index = index        # corpus extracted lazily, off hot path
         self._oracle = None        # (fn, device operands) once built
-        self._seq = 0
-        self._seq_lock = threading.Lock()
+        from ..core import lockdep
+        self._seq = 0  # guarded_by: _seq_lock
+        self._seq_lock = lockdep.lock("RecallEstimator._seq_lock")
         self._queue: "queue.Queue[_Sample]" = queue.Queue(
             maxsize=self.config.queue_max)
-        self._state_lock = threading.Lock()
-        self._windows: Dict[int, deque] = {}   # level -> (hits, slots) deque
-        self.samples_total = 0     # sampled requests processed (cumulative)
-        self.samples_below_floor = 0
-        self._floor: Optional[float] = None    # set by SloEvaluator
+        self._state_lock = lockdep.lock("RecallEstimator._state_lock")
+        self._windows: Dict[int, deque] = {}   # guarded_by: _state_lock
+        self.samples_total = 0       # guarded_by: _state_lock
+        self.samples_below_floor = 0  # guarded_by: _state_lock
+        self._floor: Optional[float] = None    # guarded_by: _state_lock
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         # registry families (idempotent getters)
@@ -430,8 +431,8 @@ class RecallEstimator:
 
         expects(self._thread is None, "estimator already started")
         self._stop.clear()
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="raft-tpu-quality")
+        self._thread = threading.Thread(  # racelint: disable=JX14 the oracle worker owns its compiled exact-scan executable; it was built through the gated searcher path before start()
+            target=self._loop, daemon=True, name="raft-tpu-quality")
         self._thread.start()
         return self
 

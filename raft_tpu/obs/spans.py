@@ -113,6 +113,7 @@ class SpanRecorder:
 
     def __init__(self, capacity_per_thread: int = 4096, *,
                  clock_ns=time.monotonic_ns, enabled: bool = True) -> None:
+        from ..core import lockdep
         from ..core.errors import expects
 
         expects(capacity_per_thread >= 1,
@@ -120,8 +121,8 @@ class SpanRecorder:
         self.capacity_per_thread = int(capacity_per_thread)
         self.clock_ns = clock_ns
         self.enabled = bool(enabled)
-        self._lock = threading.Lock()
-        self._rings: Dict[int, _Ring] = {}      # tid -> ring
+        self._lock = lockdep.lock("SpanRecorder._lock")
+        self._rings: Dict[int, _Ring] = {}      # guarded_by: _lock  tid -> ring
         self._tls = threading.local()
 
     # -- per-thread state ---------------------------------------------------
@@ -274,7 +275,7 @@ class SpanRecorder:
         }
 
 
-_default: Optional[SpanRecorder] = None
+_default: Optional[SpanRecorder] = None  # guarded_by: _default_lock
 _default_lock = threading.Lock()
 
 

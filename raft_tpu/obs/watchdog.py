@@ -205,8 +205,8 @@ class StallWatchdog:
 
         expects(self._thread is None, "watchdog already started")
         self._stop.clear()
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="raft-tpu-stall-watchdog")
+        self._thread = threading.Thread(  # racelint: disable=JX14 the watchdog's only jax touch is the profiler capture on the stall path — collecting that evidence is its whole job
+            target=self._loop, daemon=True, name="raft-tpu-stall-watchdog")
         self._thread.start()
         return self
 

@@ -39,6 +39,8 @@ from __future__ import annotations
 import json
 import os
 import threading
+
+from ...core import lockdep
 from typing import Optional, Tuple
 
 __all__ = ["probe_backend", "mosaic_gate", "dispatch_mode",
@@ -48,9 +50,9 @@ _ARTIFACT = os.path.normpath(os.path.join(
     os.path.dirname(os.path.abspath(__file__)),
     "..", "..", "..", "bench", "MOSAIC_CHECK.json"))
 
-_lock = threading.Lock()
-_cache: dict = {}
-_logged: set = set()
+_lock = lockdep.lock("pallas_gate._lock")
+_cache: dict = {}    # guarded_by: _lock
+_logged: set = set()  # guarded_by: _lock
 
 
 def reset_gate() -> None:

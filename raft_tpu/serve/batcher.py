@@ -17,11 +17,11 @@ shape.
 from __future__ import annotations
 
 import dataclasses
-import threading
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..core import lockdep
 from .bucketing import bucket_for
 
 __all__ = ["Request", "SplitSink", "plan_batch"]
@@ -36,9 +36,9 @@ class SplitSink:
 
     def __init__(self, future, n_parts: int) -> None:
         self.future = future
-        self._lock = threading.Lock()
-        self._parts: List = [None] * n_parts
-        self._missing = n_parts
+        self._lock = lockdep.lock("SplitSink._lock")
+        self._parts: List = [None] * n_parts  # guarded_by: _lock
+        self._missing = n_parts               # guarded_by: _lock
 
     def deliver(self, part: int, dist: np.ndarray, idx: np.ndarray) -> None:
         with self._lock:

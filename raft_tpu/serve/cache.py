@@ -13,13 +13,12 @@ more than ``len(ladder)`` executables per (family, k, dtype, level).
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Callable, Tuple
 
 import jax
 
-from ..core import tracing
+from ..core import lockdep, tracing
 
 __all__ = ["ExecutableCache"]
 
@@ -41,12 +40,12 @@ class ExecutableCache:
     """
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._entries: dict = {}
-        self.hits = 0
-        self.misses = 0
-        self.compiles = 0
-        self.compile_s = 0.0
+        self._lock = lockdep.lock("ExecutableCache._lock")
+        self._entries: dict = {}  # guarded_by: _lock
+        self.hits = 0             # guarded_by: _lock
+        self.misses = 0           # guarded_by: _lock
+        self.compiles = 0         # guarded_by: _lock
+        self.compile_s = 0.0      # guarded_by: _lock
 
     def __len__(self) -> int:
         with self._lock:

@@ -46,10 +46,10 @@ injector from the environment — the chaos-smoke hook for
 from __future__ import annotations
 
 import os
-import threading
 import time
 from typing import Optional
 
+from ..core import lockdep
 from .admission import ServeError
 
 __all__ = ["FaultError", "WedgedDevice", "DeviceOOM", "SwapFailed",
@@ -137,9 +137,9 @@ class FaultInjector:
     absence of a crash."""
 
     def __init__(self, sleep=time.sleep) -> None:
-        self._lock = threading.Lock()
-        self._armed: dict = {}     # site -> list of (kind, delay_ms)
-        self.fired: dict = {}      # (site, kind) -> count
+        self._lock = lockdep.lock("FaultInjector._lock")
+        self._armed: dict = {}     # guarded_by: _lock  site -> [(kind, delay_ms)]
+        self.fired: dict = {}      # guarded_by: _lock  (site, kind) -> count
         self._sleep = sleep
 
     @classmethod

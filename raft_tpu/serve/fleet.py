@@ -41,7 +41,6 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import threading
 import time
 from functools import lru_cache
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -51,6 +50,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..core import lockdep
 from ..core.compat import shard_map
 from ..core.errors import expects
 from ..distance.pairwise import sq_l2
@@ -555,7 +555,7 @@ class FleetRouter:
         expects(len(replicas) >= 1, "router needs at least one replica")
         self.replicas: List[Any] = list(replicas)
         self.clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("FleetRouter._lock")
         reg = registry if registry is not None else obs_metrics.registry()
         self.registry = reg
         self._routed = reg.counter(

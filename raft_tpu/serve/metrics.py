@@ -25,10 +25,10 @@ from __future__ import annotations
 
 import json
 import math
-import threading
 from collections import deque
 from typing import Optional, Sequence
 
+from ..core import lockdep
 from ..obs.metrics import (DEFAULT_LATENCY_BOUNDARIES_MS, MetricRegistry)
 
 __all__ = ["ServingMetrics", "UnknownCounter", "percentile"]
@@ -99,8 +99,8 @@ class ServingMetrics:
                  registry: Optional[MetricRegistry] = None,
                  latency_boundaries_ms: Sequence[float] =
                  DEFAULT_LATENCY_BOUNDARIES_MS) -> None:
-        self._lock = threading.Lock()
-        self._lat_ms = deque(maxlen=int(latency_window))
+        self._lock = lockdep.lock("ServingMetrics._lock")
+        self._lat_ms = deque(maxlen=int(latency_window))  # guarded_by: _lock
         self.registry = registry if registry is not None else MetricRegistry()
         self._counters = {}
         for field, help_ in COUNTER_SPECS:

@@ -22,8 +22,9 @@ across swaps (see ``SearchServer._compiled``).
 from __future__ import annotations
 
 import dataclasses
-import threading
 from typing import Any
+
+from ..core import lockdep
 
 __all__ = ["Generation", "IndexRegistry"]
 
@@ -46,9 +47,9 @@ class IndexRegistry:
     several background builders race."""
 
     def __init__(self, index, *, on_swap=None) -> None:
-        self._lock = threading.Lock()
-        self._current = Generation(index, 0)
-        self.swaps = 0
+        self._lock = lockdep.lock("IndexRegistry._lock")
+        self._current = Generation(index, 0)  # guarded_by: _lock  (reads are lock-free reference loads)
+        self.swaps = 0                        # guarded_by: _lock
         #: optional callable invoked with each newly installed
         #: :class:`Generation`, outside the lock (the server hangs its
         #: index-health export here — see ``neighbors.health``)

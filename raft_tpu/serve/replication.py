@@ -66,6 +66,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ..core import lockdep
 from ..core.errors import expects
 from ..core.serialize import CorruptArtifact, fsync_dir, write_text_atomic
 from ..neighbors.serialize import index_manifest
@@ -188,7 +189,7 @@ class SocketTransport:
         except OSError:
             pass
         self._buf = b""
-        self._send_lock = threading.Lock()
+        self._send_lock = lockdep.lock("SocketTransport._send_lock")
         self.closed = False
 
     @classmethod
@@ -198,7 +199,7 @@ class SocketTransport:
 
     def send(self, blob: bytes) -> None:
         with self._send_lock:
-            self._sock.sendall(blob)
+            self._sock.sendall(blob)  # racelint: disable=JX12 the send IS this lock's job: frames must hit the wire whole, and _send_lock is a per-connection leaf nothing else nests under it
 
     def _parse(self) -> Optional[Message]:
         if len(self._buf) < _MSG_HEADER.size:
@@ -311,8 +312,8 @@ class EpochFence:
         self.epoch = int(epoch)
         self.writer = bool(writer)
         self.root = os.fspath(root) if root is not None else None
-        self._lock = threading.Lock()
-        self._max_seen = EpochToken(self.epoch, self.node_id)
+        self._lock = lockdep.lock("EpochFence._lock")
+        self._max_seen = EpochToken(self.epoch, self.node_id)  # guarded_by: _lock
 
     @property
     def token(self) -> EpochToken:
@@ -497,9 +498,12 @@ class LogShipper:
             # a primary's authority must outrank all unclaimed tokens,
             # so shipping starts by claiming epoch 1
             self.fence.advance()
+        # _ack_t / _follower_link / _last_beat are owned by whichever
+        # single thread drives pump()/beat() — the heartbeat loop or a
+        # test harness, never both at once — so they stay unguarded
         self._ack_t: Dict[str, float] = {}  # follower -> clock at last ack
         self._follower_link: Dict[str, Any] = {}  # follower -> hello's link
-        self._cond = threading.Condition()
+        self._cond = lockdep.condition("LogShipper._cond")
         self._last_beat = float("-inf")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core import tracing
+from ..core import lockdep, tracing
 from ..core.errors import expects
 from ..core.logging import default_logger
 from ..obs import spans as obs_spans
@@ -145,14 +145,16 @@ class SearchServer:
         # its own (tests; multi-server hosts separating evidence)
         self.recorder = recorder if recorder is not None \
             else obs_spans.recorder()
+        # _inflight is deliberately lock-free: a single tuple reference
+        # swapped whole by the dispatch thread, read racily by observers
         self._inflight = None      # (site, t0) while a dispatch is on-device
         self._log = default_logger() if res is None else None
-        self._cond = threading.Condition()
-        self._parts_lock = threading.Lock()
-        self._searchers: dict = {}   # (gen_id, k, level) -> (fn, operands)
-        self._pending: list = []
+        self._cond = lockdep.condition("SearchServer._cond")
+        self._parts_lock = lockdep.lock("SearchServer._parts_lock")
+        self._searchers: dict = {}   # guarded_by: _parts_lock
+        self._pending: list = []     # guarded_by: _cond
         self._thread: Optional[threading.Thread] = None
-        self._running = False
+        self._running = False        # guarded_by: _cond
         # quality telemetry (opt-in via attach_quality); index-health
         # gauges are always on — recomputed for every swapped-in
         # generation so a bad compaction is visible in one scrape
@@ -280,9 +282,10 @@ class SearchServer:
         expects(self._thread is None, "server already started")
         if warmup:
             self.warmup()
-        self._running = True
-        self._thread = threading.Thread(target=self._worker,
-                                        name="raft-tpu-serve", daemon=True)
+        with self._cond:
+            self._running = True
+        self._thread = threading.Thread(  # racelint: disable=JX14 dispatch thread owns its compiled executables (ExecutableCache built them under the pallas gate before serving)
+            target=self._worker, name="raft-tpu-serve", daemon=True)
         self._thread.start()
         return self
 

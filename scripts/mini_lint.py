@@ -20,8 +20,10 @@ surprise:
 (:mod:`raft_tpu.analysis.jaxlint` — JX01..JX05, see docs/jax_hygiene.md)
 over the same tree through the same reporting and exit-code contract;
 ``--stats-json PATH`` dumps the analyzer census (rules fired, waivers,
-files scanned) as a JSON artifact.  The analyzer module is loaded by file
-path, so running the linter never imports jax.
+files scanned) as a JSON artifact.  ``--race`` does the same with the
+concurrency analyzer (:mod:`raft_tpu.analysis.racelint` — JX10..JX14;
+``--race-stats-json PATH`` for its census).  Analyzer modules are loaded
+by file path, so running the linter never imports jax.
 
 Exit 1 when findings exist.  ``--fix`` repairs the whitespace class only
 (the code classes deserve human eyes).
@@ -190,34 +192,62 @@ def check_file(path: str, fix: bool = False):
     return findings
 
 
-def _load_jaxlint():
-    """Load the analyzer module by file path — never imports raft_tpu (and
+def _load_analyzer(name: str):
+    """Load an analyzer module by file path — never imports raft_tpu (and
     therefore never imports jax): the linter must run on a bare host."""
     import importlib.util
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    mod_path = os.path.join(repo, "raft_tpu", "analysis", "jaxlint.py")
-    spec = importlib.util.spec_from_file_location("jaxlint", mod_path)
+    mod_path = os.path.join(repo, "raft_tpu", "analysis", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, mod_path)
     module = importlib.util.module_from_spec(spec)
-    sys.modules["jaxlint"] = module  # dataclasses needs the module registered
+    sys.modules[name] = module  # dataclasses needs the module registered
     spec.loader.exec_module(module)
     return module
+
+
+def _load_jaxlint():
+    return _load_analyzer("jaxlint")
+
+
+def _run_analyzer(name: str, root: str, stats_path, all_findings) -> str:
+    """Run one analyzer over ``root`` through the shared reporting/exit
+    contract; returns the summary note for the footer line."""
+    mod = _load_analyzer(name)
+    rep = mod.scan_tree(root)
+    for f in rep.findings:
+        all_findings.append((f.path, f.line, f.code, f.msg))
+    note = (f"; {name}: {rep.files} files, "
+            f"{len(rep.findings)} active, {len(rep.waived)} waived")
+    if stats_path:
+        import json
+
+        os.makedirs(os.path.dirname(stats_path) or ".", exist_ok=True)
+        with open(stats_path, "w", encoding="utf-8") as fh:
+            json.dump(rep.stats(), fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        note += f"; stats -> {stats_path}"
+    return note
 
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     fix = "--fix" in argv
     jax_pass = "--jax" in argv
+    race_pass = "--race" in argv
     stats_path = None
     if "--stats-json" in argv:
         stats_path = argv[argv.index("--stats-json") + 1]
+    race_stats_path = None
+    if "--race-stats-json" in argv:
+        race_stats_path = argv[argv.index("--race-stats-json") + 1]
     skip_next = False
     root = "."
     for a in argv:
         if skip_next:
             skip_next = False
             continue
-        if a == "--stats-json":
+        if a in ("--stats-json", "--race-stats-json"):
             skip_next = True
         elif not a.startswith("-"):
             root = a
@@ -230,20 +260,10 @@ def main(argv=None) -> int:
 
     jax_note = ""
     if jax_pass:
-        jaxlint = _load_jaxlint()
-        rep = jaxlint.scan_tree(root)
-        for f in rep.findings:
-            all_findings.append((f.path, f.line, f.code, f.msg))
-        jax_note = (f"; jaxlint: {rep.files} files, "
-                    f"{len(rep.findings)} active, {len(rep.waived)} waived")
-        if stats_path:
-            import json
-
-            os.makedirs(os.path.dirname(stats_path) or ".", exist_ok=True)
-            with open(stats_path, "w", encoding="utf-8") as fh:
-                json.dump(rep.stats(), fh, indent=2, sort_keys=True)
-                fh.write("\n")
-            jax_note += f"; stats -> {stats_path}"
+        jax_note += _run_analyzer("jaxlint", root, stats_path, all_findings)
+    if race_pass:
+        jax_note += _run_analyzer("racelint", root, race_stats_path,
+                                  all_findings)
 
     for path, line, code, msg in all_findings:
         print(f"{path}:{line}: {code} {msg}")

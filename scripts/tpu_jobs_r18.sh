@@ -1,0 +1,101 @@
+#!/bin/bash
+# Round-18 TPU job queue: concurrency-discipline round for the threaded
+# serving stack (racelint + lockdep — ISSUE 17).
+#   * racelint runs FIRST and costs zero chip time: the AST pass
+#     (JX10..JX14) over the whole library must report zero active
+#     findings and re-stamp bench/RACELINT.json.  jaxlint rides along —
+#     the two analyzers share the reporting contract.
+#   * mosaic re-stamps bench/MOSAIC_CHECK.json, as always — the dispatch
+#     gate rejects stale kernel_sha stamps.
+#   * lockdep_gate — the runtime arm where the threads are real: the
+#     four threaded suites (serve lifecycle, compaction, replication,
+#     fleet) run with RAFT_LOCKDEP=1 and the session census must record
+#     zero lock-order inversions while actually observing edges (a
+#     vacuous empty graph fails the step).  On TPU the dispatch thread
+#     holds real device waits, so the hold-time histogram
+#     (raft_lockdep_hold_seconds) gets its first hardware-true samples.
+#   * serve_bench re-baselines the serving QPS with the instrumented
+#     (but disarmed) locks in place — the wrappers must cost nothing on
+#     the hot path, and this curve is the evidence.
+# Stage order: racelint -> mosaic -> lockdep gate -> serve bench ->
+# bench.py.
+# Markers stay in /tmp/tpu_jobs_r3 so steps completed by earlier rounds'
+# queues are not repeated.
+set -u
+cd /root/repo || exit 1
+LOG=/tmp/tpu_jobs_r3
+mkdir -p "$LOG"
+. "$(dirname "$0")/tpu_queue_lib.sh"
+acquire_queue_lock tpu_jobs_r18
+export RAFT_BENCH_CKPT_DIR="$LOG/bench_ckpt"
+
+echo "$(date) [r18 queue] waiting for TPU..." >> "$LOG/driver.log"
+wait_probe
+echo "$(date) TPU is back" >> "$LOG/driver.log"
+
+run_step() {  # name, timeout_s, command...   (two attempts, gated .done)
+  local name=$1 tmo=$2; shift 2
+  [ -f "$LOG/$name.done" ] && return 0
+  local attempt
+  for attempt in 1 2; do
+    echo "$(date) start $name (attempt $attempt)" >> "$LOG/driver.log"
+    timeout "$tmo" "$@" > "$LOG/$name.$attempt.log" 2>&1 9<&-
+    rc=$?
+    cp -f "$LOG/$name.$attempt.log" "$LOG/$name.log"  # latest = canonical
+    if [ "$rc" -eq 0 ]; then
+      if [ "$name" != bench ] || bench_measured "$LOG/$name.log" brute_force; then
+        touch "$LOG/$name.done"
+        echo "$(date) done $name" >> "$LOG/driver.log"
+        return 0
+      fi
+      echo "$(date) $name exited 0 with no headline measurement (wedged backend)" \
+        >> "$LOG/driver.log"
+    else
+      echo "$(date) FAILED $name (rc=$rc)" >> "$LOG/driver.log"
+    fi
+    # a killed/wedged client can poison the tunnel for the next step too:
+    # re-probe before the retry (or before handing on to the next step)
+    wait_probe
+  done
+}
+
+# racelint first: pure-host AST pass, zero chip time — the concurrency
+# census must stay at zero active findings before any threaded step runs
+run_step racelint_r18   300 python scripts/mini_lint.py --race raft_tpu \
+  --race-stats-json bench/RACELINT.json
+run_step jaxlint_r18    300 python scripts/mini_lint.py --jax raft_tpu \
+  --stats-json bench/JAXLINT.json
+# mosaic BEFORE anything that dispatches Pallas: re-validates the kernels
+# on hardware and stamps the sha-scoped artifact the dispatch gate needs
+run_step mosaic         900 env RAFT_MOSAIC_REQUIRE_TPU=1 python scripts/mosaic_check.py
+# the runtime gate: four threaded suites with lockdep armed; the census
+# must show zero inversions AND a non-empty order graph (written to a
+# file first: run_step retries must not re-read stdin)
+cat > "$LOG/lockdep_gate.py" <<'PY'
+import json, os, subprocess, sys
+
+os.chdir("/root/repo")
+report = "/tmp/tpu_jobs_r3/lockdep_report.json"
+env = dict(os.environ, RAFT_LOCKDEP="1", RAFT_LOCKDEP_REPORT=report)
+proc = subprocess.run(
+    [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+     "-m", "not slow",
+     "tests/test_serve_lifecycle.py", "tests/test_compaction.py",
+     "tests/test_replication.py", "tests/test_fleet.py"],
+    env=env)
+assert proc.returncode == 0, proc.returncode
+census = json.load(open(report))
+assert census["inversion_total"] == 0, census["inversions"]
+assert census["edges"], "no lock-order edges recorded — lockdep unarmed?"
+print(json.dumps({"config": "lockdep_gate", "inversions": 0,
+                  "edges": len(census["edges"])}))
+PY
+run_step lockdep_gate   1800 python "$LOG/lockdep_gate.py"
+# QPS re-baseline with the instrumented-but-disarmed locks on the hot
+# path: the serving curve must hold the r16 ratchet
+run_step serve_bench    1800 env RAFT_BENCH_SERVE_ROWS=2000 \
+  RAFT_BENCH_SERVE_DIM=32 RAFT_BENCH_SERVE_K=8 \
+  RAFT_BENCH_SERVE_LADDER=1,8 RAFT_BENCH_SERVE_SECONDS=6 \
+  python bench/serve.py
+run_step bench         4500 python bench.py
+echo "$(date) all steps attempted" >> "$LOG/driver.log"

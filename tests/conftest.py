@@ -42,3 +42,35 @@ def mesh2x4(devices):
 @pytest.fixture()
 def rng():
     return np.random.default_rng(42)
+
+
+@pytest.fixture()
+def lockdep_enabled():
+    """Arm lockdep for one test with a clean order graph; restores the
+    prior arming state (so a RAFT_LOCKDEP=1 session keeps its census)."""
+    from raft_tpu.core import lockdep
+
+    was = lockdep.enabled()
+    if not was:
+        lockdep.reset()
+    lockdep.enable()
+    yield lockdep
+    if not was:
+        lockdep.disable()
+        lockdep.reset()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """RAFT_LOCKDEP_REPORT=<path>: write the lock-order census (edges,
+    inversions) observed across the whole session — the artifact the
+    zero-inversion suite gate and ``scripts/tpu_jobs_r18.sh`` read."""
+    path = os.environ.get("RAFT_LOCKDEP_REPORT")
+    if not path:
+        return
+    import json
+
+    from raft_tpu.core import lockdep
+
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(lockdep.report(), fh, indent=2, sort_keys=True)
+        fh.write("\n")

@@ -33,7 +33,7 @@ def test_serving_guide_snippets_execute():
 
 
 def test_jax_hygiene_snippets_execute():
-    _run_guide("jax_hygiene.md", min_blocks=6)
+    _run_guide("jax_hygiene.md", min_blocks=9)
 
 
 def test_mutability_guide_snippets_execute():

@@ -1,0 +1,366 @@
+"""racelint rule fixtures: each of JX10–JX14 firing AND waived, the
+JXW1 reasonless-waiver contract, and the tree gate (the library scans
+clean — the census ``bench/RACELINT.json`` commits).
+
+The fixtures pass ``rel`` paths under ``raft_tpu/`` so the driver/test
+allowlists (which exempt ``tests/`` itself) do not apply.
+"""
+
+import os
+import textwrap
+
+from raft_tpu.analysis import racelint
+
+LIB = "raft_tpu/serve/fixture.py"
+
+
+def _scan(src: str, rel: str = LIB):
+    return racelint.scan_source(textwrap.dedent(src), rel, rel)
+
+
+def _active(findings, code):
+    return [f for f in findings if f.code == code and not f.waived]
+
+
+def _waived(findings, code):
+    return [f for f in findings if f.code == code and f.waived]
+
+
+# -- JX10: guarded-attribute writes -------------------------------------
+
+
+def test_jx10_fires_on_unguarded_assign_and_mutator():
+    fs = _scan("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []  # guarded_by: _lock
+
+            def put(self, x):
+                self.items.append(x)
+
+            def reset(self):
+                self.items = []
+        """)
+    hits = _active(fs, "JX10")
+    assert len(hits) == 2
+    assert all("items" in f.msg for f in hits)
+
+
+def test_jx10_quiet_under_lock_ctor_and_holds_annotation():
+    fs = _scan("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []  # guarded_by: _lock
+                self.items = ["ctor writes are thread-private"]
+
+            def put(self, x):
+                with self._lock:
+                    self.items.append(x)
+
+            def _put_locked(self, x):  # racelint: holds _lock
+                self.items.append(x)
+        """)
+    assert not _active(fs, "JX10")
+
+
+def test_jx10_module_level_guard():
+    fs = _scan("""
+        import threading
+
+        _lock = threading.Lock()
+        _stats = {"n": 0}  # guarded_by: _lock
+
+        def bump():
+            _stats["n"] += 1
+
+        def bump_locked():
+            with _lock:
+                _stats["n"] += 1
+        """)
+    hits = _active(fs, "JX10")
+    assert len(hits) == 1 and "_stats" in hits[0].msg
+
+
+def test_jx10_waiver_with_reason():
+    fs = _scan("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []  # guarded_by: _lock
+
+            def rebuild(self):
+                self.items = []  # racelint: disable=JX10 swap happens before worker start
+        """)
+    assert not _active(fs, "JX10")
+    w = _waived(fs, "JX10")
+    assert len(w) == 1 and "worker start" in w[0].reason
+
+
+# -- JX11: lock-order consistency ---------------------------------------
+
+
+def test_jx11_fires_on_reversed_order():
+    fs = _scan("""
+        import threading
+
+        class Two:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def ab(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def ba(self):
+                with self._b:
+                    with self._a:
+                        pass
+        """)
+    hits = _active(fs, "JX11")
+    assert len(hits) == 2
+    assert any("Two._a" in f.msg and "Two._b" in f.msg for f in hits)
+
+
+def test_jx11_quiet_on_consistent_order():
+    fs = _scan("""
+        import threading
+
+        class Two:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def ab(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def also_ab(self):
+                with self._a, self._b:
+                    pass
+        """)
+    assert not _active(fs, "JX11")
+
+
+# -- JX12: blocking under a lock ----------------------------------------
+
+
+def test_jx12_fires_on_sleep_and_fsync_under_lock():
+    fs = _scan("""
+        import os
+        import threading
+        import time
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def spin(self):
+                with self._lock:
+                    time.sleep(0.5)
+
+            def flush(self, fd):
+                with self._lock:
+                    os.fsync(fd)
+        """)
+    assert len(_active(fs, "JX12")) == 2
+
+
+def test_jx12_matches_underscored_seams_and_respects_waivers():
+    fs = _scan("""
+        import threading
+
+        class W:
+            def __init__(self, fsync):
+                self._lock = threading.Lock()
+                self._fsync = fsync
+
+            def flush(self, fd):
+                with self._lock:
+                    self._fsync(fd)  # racelint: disable=JX12 the fsync is this path's whole job
+
+            def flush_loud(self, fd):
+                with self._lock:
+                    self._fsync(fd)
+        """)
+    assert len(_active(fs, "JX12")) == 1
+    assert len(_waived(fs, "JX12")) == 1
+
+
+def test_jx12_exempt_in_tests_and_scripts():
+    src = """
+        import threading
+        import time
+
+        _lock = threading.Lock()
+
+        def drill():
+            with _lock:
+                time.sleep(1.0)
+        """
+    assert _active(_scan(src, "tests/test_drill.py"), "JX12") == []
+    assert _active(_scan(src, "scripts/drill.py"), "JX12") == []
+    assert len(_active(_scan(src, LIB), "JX12")) == 1
+
+
+# -- JX13: callbacks under undocumented locks ---------------------------
+
+
+def test_jx13_fires_on_undocumented_hook_call_and_loop():
+    fs = _scan("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.on_commit = []
+
+            def commit(self, rec):
+                with self._lock:
+                    for hook in list(self.on_commit):
+                        hook(rec)
+        """)
+    assert len(_active(fs, "JX13")) == 1
+
+
+def test_jx13_quiet_when_documented_called_under():
+    fs = _scan("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.on_commit = []  # called_under: _lock hooks see LSN order
+
+            def commit(self, rec):
+                with self._lock:
+                    for hook in list(self.on_commit):
+                        hook(rec)
+        """)
+    assert not _active(fs, "JX13")
+
+
+def test_jx13_waiver():
+    fs = _scan("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.on_swap = None
+
+            def swap(self):
+                with self._lock:
+                    self.on_swap()  # racelint: disable=JX13 single wired callee, documented in the class docstring
+        """)
+    assert not _active(fs, "JX13")
+    assert len(_waived(fs, "JX13")) == 1
+
+
+# -- JX14: daemon threads touching jax dispatch -------------------------
+
+_JX14_SRC = """
+    import threading
+
+    import jax
+
+    class Worker:
+        def _loop(self):
+            self._step()
+
+        def _step(self):
+            jax.effects_barrier()
+
+        def start(self):
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+"""
+
+
+def test_jx14_fires_through_same_class_helpers():
+    hits = _active(_scan(_JX14_SRC), "JX14")
+    assert len(hits) == 1 and "_loop" in hits[0].msg
+
+
+def test_jx14_quiet_for_jax_free_target_and_exempt_paths():
+    fs = _scan("""
+        import threading
+
+        class Worker:
+            def _loop(self):
+                pass
+
+            def start(self):
+                self._thread = threading.Thread(target=self._loop)
+        """)
+    assert not _active(fs, "JX14")
+    assert _active(_scan(_JX14_SRC, "tests/test_worker.py"), "JX14") == []
+
+
+def test_jx14_waiver():
+    fs = _scan(_JX14_SRC.replace(
+        "threading.Thread(target=self._loop, daemon=True)",
+        "threading.Thread(  # racelint: disable=JX14 owns its compiled executable\n"
+        "                target=self._loop, daemon=True)"))
+    assert not _active(fs, "JX14")
+    assert len(_waived(fs, "JX14")) == 1
+
+
+# -- JXW1 + report plumbing ---------------------------------------------
+
+
+def test_reasonless_waiver_still_waives_but_is_itself_a_finding():
+    fs = _scan("""
+        import threading
+        import time
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def spin(self):
+                with self._lock:
+                    time.sleep(0.5)  # racelint: disable=JX12
+        """)
+    assert not _active(fs, "JX12")
+    assert len(_waived(fs, "JX12")) == 1
+    assert len(_active(fs, "JXW1")) == 1
+
+
+def test_unparseable_source_is_jx99():
+    fs = _scan("def broken(:\n")
+    assert [f.code for f in fs] == ["JX99"]
+
+
+def test_stats_schema_matches_jaxlint_contract():
+    rep = racelint.Report([], [], 3)
+    st = rep.stats()
+    for key in ("tool", "files_scanned", "rules_fired", "unwaived_findings",
+                "waivers", "waiver_total", "waiver_sites", "rule_catalog"):
+        assert key in st
+    assert st["tool"] == "racelint"
+    assert st["rule_catalog"] == racelint.ALL_RULES
+
+
+# -- the gate: the library tree scans clean -----------------------------
+
+
+def test_library_tree_has_zero_active_findings():
+    root = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "raft_tpu")
+    rep = racelint.scan_tree(root)
+    assert rep.files > 100
+    msgs = [f"{f.path}:{f.line} {f.code} {f.msg}" for f in rep.findings]
+    assert not msgs, "\n".join(msgs)
+    # every waiver in the tree carries a written reason
+    assert all(f.reason for f in rep.waived)
